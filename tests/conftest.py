@@ -1,0 +1,12 @@
+"""Test harness config: force a virtual 8-device CPU mesh before JAX imports.
+
+Mirrors the reference's in-process multi-node test strategy (onet LocalTest,
+reference: services/service_test.go:29-66) — multi-"node" here means multiple
+XLA host devices so sharding/collective paths run for real without TPUs.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

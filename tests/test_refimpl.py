@@ -1,0 +1,59 @@
+"""Oracle self-tests: the pure-Python bn256 stack must be internally
+consistent (group laws, bilinearity, non-degeneracy) before it can serve as
+the correctness oracle for the device kernels.
+
+Mirrors the reference's crypto-sanity tier (reference: lib/range/
+range_proof_test.go:14-77 exercises pairings; lib/encoding/*_test.go relies on
+ElGamal round-trips).
+"""
+import random
+
+from drynx_tpu.crypto import params, refimpl as r
+
+
+def test_params():
+    assert params.P % 4 == 3
+    assert (params.P**12 - 1) % params.N == 0
+    assert params.from_limbs(params.to_limbs(params.P - 1)) == params.P - 1
+
+
+def test_fp2_field():
+    rng = random.Random(1)
+    for _ in range(20):
+        a = (rng.randrange(params.P), rng.randrange(params.P))
+        b = (rng.randrange(params.P), rng.randrange(params.P))
+        assert r.fp2_mul(a, r.fp2_inv(a)) == r.FP2_ONE
+        assert r.fp2_mul(a, b) == r.fp2_mul(b, a)
+        assert r.fp2_sq(a) == r.fp2_mul(a, a)
+        s = r.fp2_sqrt(r.fp2_sq(a))
+        assert s in (a, r.fp2_neg(a))
+
+
+def test_g1_group_law():
+    rng = random.Random(2)
+    for _ in range(10):
+        k1, k2 = rng.randrange(params.N), rng.randrange(params.N)
+        p1, p2 = r.g1_mul(r.G1, k1), r.g1_mul(r.G1, k2)
+        assert r.g1_is_on_curve(p1)
+        assert r.g1_add(p1, p2) == r.g1_mul(r.G1, (k1 + k2) % params.N)
+    assert r.g1_mul(r.G1, params.N) is None
+    assert r.g1_add(r.G1, r.g1_neg(r.G1)) is None
+
+
+def test_g2_group_law():
+    rng = random.Random(3)
+    k1, k2 = rng.randrange(params.N), rng.randrange(params.N)
+    q1, q2 = r.g2_mul(r.G2, k1), r.g2_mul(r.G2, k2)
+    assert r.g2_is_on_curve(q1)
+    assert r.g2_add(q1, q2) == r.g2_mul(r.G2, (k1 + k2) % params.N)
+    assert r.g2_mul(r.G2, params.N) is None
+
+
+def test_pairing_bilinear_nondegenerate():
+    e = r.pair(r.G1, r.G2)
+    assert e != r.FP12_ONE
+    assert r.fp12_pow(e, params.N) == r.FP12_ONE
+    a, b = 987654321, 123456789
+    assert r.pair(r.g1_mul(r.G1, a), r.G2) == r.fp12_pow(e, a)
+    assert r.pair(r.G1, r.g2_mul(r.G2, b)) == r.fp12_pow(e, b)
+    assert r.pair(r.g1_mul(r.G1, a), r.g2_mul(r.G2, b)) == r.fp12_pow(e, a * b % params.N)
