@@ -42,8 +42,15 @@ G1_GEN = (1, 2)
 TRACE = 6 * U**2 + 1
 assert P + 1 - TRACE == N
 
-# Twist curve order over Fp2 (D-type twist): #E'(Fp2) = n * (2p - n)
-TWIST_COFACTOR = 2 * P - N
+# Twist curve order over Fp2 for the D-type sextic twist y^2 = x^3 + 3/XI:
+# with t2 = t^2 - 2p (trace of E over Fp2) and f2 = sqrt((4p^2 - t2^2)/3),
+# #E'(Fp2) = p^2 + 1 - (t2 + 3*f2)/2  (verified empirically; divisible by N).
+_T2 = TRACE * TRACE - 2 * P
+_F2 = 65000549695646603729472583186153816235393533837839825629408311602454630816845
+assert 3 * _F2 * _F2 == 4 * P * P - _T2 * _T2
+TWIST_ORDER = P * P + 1 - (_T2 + 3 * _F2) // 2
+assert TWIST_ORDER % N == 0
+TWIST_COFACTOR = TWIST_ORDER // N
 
 # ---------------------------------------------------------------------------
 # Limb layout (device representation)

@@ -249,8 +249,8 @@ def g2_add(p1, p2):
     return (x3, y3)
 
 
-def g2_mul(pt, k):
-    k %= N
+def g2_mul_raw(pt, k):
+    """Scalar mult WITHOUT mod-N reduction (for order checks)."""
     acc = None
     add = pt
     while k:
@@ -259,6 +259,10 @@ def g2_mul(pt, k):
         add = g2_add(add, add)
         k >>= 1
     return acc
+
+
+def g2_mul(pt, k):
+    return g2_mul_raw(pt, k % N)
 
 
 def _find_g2_generator():
@@ -270,8 +274,8 @@ def _find_g2_generator():
             y = fp2_sqrt(rhs)
             if y is None:
                 continue
-            q = g2_mul((x, y), params.TWIST_COFACTOR)
-            if q is not None and g2_mul(q, N) is None:
+            q = g2_mul_raw((x, y), params.TWIST_COFACTOR)
+            if q is not None and g2_mul_raw(q, N) is None:
                 return q
     raise AssertionError("no G2 generator found")
 

@@ -46,7 +46,7 @@ def test_g2_group_law():
     q1, q2 = r.g2_mul(r.G2, k1), r.g2_mul(r.G2, k2)
     assert r.g2_is_on_curve(q1)
     assert r.g2_add(q1, q2) == r.g2_mul(r.G2, (k1 + k2) % params.N)
-    assert r.g2_mul(r.G2, params.N) is None
+    assert r.g2_mul_raw(r.G2, params.N) is None  # true order check, no mod
 
 
 def test_pairing_bilinear_nondegenerate():
