@@ -1,0 +1,274 @@
+"""Batched modular (Montgomery) arithmetic for TPU — the innermost layer.
+
+Design (TPU-first, see SURVEY.md §7): a 256-bit field element is a vector of
+16 little-endian limbs of 16 bits, stored in uint32 lanes so limb products
+(16b x 16b = 32b) never overflow.  Everything is fixed-shape, branch-free
+(selects only), and batches over arbitrary leading dims — the reference's
+per-element goroutine fan-out (unlynx StartParallelize, used at
+lib/range/range_proof.go:75 and 30+ sites) becomes plain vectorization here.
+
+Montgomery reduction runs 16 unrolled limb steps with split-half (lo/hi)
+accumulation; column magnitudes stay < 2^22, well inside uint32.
+
+Two modulus contexts are provided: FP (the bn256 base field) and FN (the
+scalar field), mirroring kyber's (Point, Scalar) split used throughout the
+reference (e.g. lib/range/range_proof.go:320-417).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import params
+from .params import LIMB_BITS, LIMB_MASK, NUM_LIMBS
+
+MASK = jnp.uint32(LIMB_MASK)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModCtx:
+    """Constants for one modulus (host ints + device arrays)."""
+
+    modulus: int
+    nprime: int          # -m^-1 mod 2^16
+    r2: int              # R^2 mod m
+    name: str
+
+    @property
+    def m_limbs(self) -> jnp.ndarray:
+        return jnp.asarray(params.to_limbs(self.modulus), dtype=jnp.uint32)
+
+    @property
+    def r2_limbs(self) -> jnp.ndarray:
+        return jnp.asarray(params.to_limbs(self.r2), dtype=jnp.uint32)
+
+    @property
+    def one_mont(self) -> jnp.ndarray:
+        """Montgomery representation of 1 (= R mod m)."""
+        return jnp.asarray(params.to_limbs(params.R % self.modulus), dtype=jnp.uint32)
+
+    @property
+    def zero(self) -> jnp.ndarray:
+        return jnp.zeros((NUM_LIMBS,), dtype=jnp.uint32)
+
+
+FP = ModCtx(params.P, params.NPRIME, params.R2_MOD_P, "Fp")
+FN = ModCtx(params.N, params.NPRIME_N, params.R2_MOD_N, "Fn")
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion helpers (numpy; not jitted)
+# ---------------------------------------------------------------------------
+
+def from_int(x, batch_shape=()) -> np.ndarray:
+    """Python int (or nested list of ints) -> uint32 limb array."""
+    arr = np.asarray(x, dtype=object)
+    out = np.zeros(arr.shape + (NUM_LIMBS,), dtype=np.uint32)
+    for idx in np.ndindex(arr.shape) if arr.shape else [()]:
+        v = int(arr[idx]) if arr.shape else int(x)
+        for k in range(NUM_LIMBS):
+            out[idx + (k,)] = (v >> (LIMB_BITS * k)) & LIMB_MASK
+    if batch_shape and not arr.shape:
+        out = np.broadcast_to(out, batch_shape + (NUM_LIMBS,)).copy()
+    return out
+
+
+def to_int(limbs) -> "int | np.ndarray":
+    """uint32 limb array -> Python int (object ndarray for batches)."""
+    a = np.asarray(limbs)
+    if a.ndim == 1:
+        return params.from_limbs(a)
+    flat = a.reshape(-1, NUM_LIMBS)
+    out = np.array([params.from_limbs(row) for row in flat], dtype=object)
+    return out.reshape(a.shape[:-1])
+
+
+# ---------------------------------------------------------------------------
+# Core limb ops (all jit-safe, batch over leading dims)
+# ---------------------------------------------------------------------------
+
+def _carry_chain(cols, out_limbs):
+    """Sequential carry propagation down a column array -> out_limbs limbs.
+
+    cols: (..., K) uint32 with values < 2^31. Returns ((..., out_limbs), carry).
+    """
+    outs = []
+    carry = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
+    for k in range(out_limbs):
+        v = cols[..., k] + carry
+        outs.append(v & MASK)
+        carry = v >> LIMB_BITS
+    return jnp.stack(outs, axis=-1), carry
+
+
+def _sub_limbs(a, b):
+    """a - b with borrow chain. Returns (diff_limbs, borrow in {0,1})."""
+    outs = []
+    borrow = jnp.zeros(a.shape[:-1] if a.ndim > 1 else (), dtype=jnp.uint32)
+    borrow = jnp.broadcast_to(borrow, jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]))
+    for k in range(NUM_LIMBS):
+        v = a[..., k] - b[..., k] - borrow  # uint32 wraparound is fine
+        outs.append(v & MASK)
+        borrow = (v >> LIMB_BITS) & jnp.uint32(1)  # 1 iff wrapped
+    return jnp.stack(outs, axis=-1), borrow
+
+
+def _cond_sub_m(a, ctx: ModCtx):
+    """Return a - m if a >= m else a (a < 2m assumed, normalized limbs)."""
+    diff, borrow = _sub_limbs(a, ctx.m_limbs)
+    return jnp.where((borrow == 0)[..., None], diff, a)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def add(a, b, ctx: ModCtx = FP):
+    """(a + b) mod m; inputs normalized (< m)."""
+    cols = a + b  # < 2^17 per limb
+    s, carry = _carry_chain(cols, NUM_LIMBS)
+    # a+b < 2m < 2^257: one carry bit possible beyond limb 15. Since m has
+    # 256 bits, if carry==1 the value >= 2^256 > m: subtract m once; the
+    # borrow from _sub_limbs cancels against carry.
+    diff, borrow = _sub_limbs(s, ctx.m_limbs)
+    use_diff = (borrow == 0) | (carry == 1)
+    return jnp.where(use_diff[..., None], diff, s)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def sub(a, b, ctx: ModCtx = FP):
+    """(a - b) mod m; inputs normalized."""
+    diff, borrow = _sub_limbs(a, b)
+    plus_m, _ = _carry_chain(diff + ctx.m_limbs, NUM_LIMBS)
+    return jnp.where((borrow == 1)[..., None], plus_m, diff)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def neg(a, ctx: ModCtx = FP):
+    return sub(jnp.zeros_like(a), a, ctx)
+
+
+@jax.jit
+def is_zero(a):
+    """Boolean (...,) — all limbs zero (valid: representation is canonical)."""
+    return jnp.all(a == 0, axis=-1)
+
+
+@jax.jit
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def mont_mul(a, b, ctx: ModCtx = FP):
+    """Montgomery product a*b*R^-1 mod m. Inputs/outputs in Montgomery form.
+
+    Schoolbook 512-bit column product with lo/hi split accumulation, then 16
+    interleaved Montgomery reduction steps (unrolled; static offsets).
+    """
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (NUM_LIMBS,))
+    b = jnp.broadcast_to(b, batch + (NUM_LIMBS,))
+
+    prod = a[..., :, None] * b[..., None, :]  # (..., 16, 16) < 2^32
+    lo = prod & MASK
+    hi = prod >> LIMB_BITS
+
+    cols = jnp.zeros(batch + (2 * NUM_LIMBS + 1,), dtype=jnp.uint32)
+    for i in range(NUM_LIMBS):
+        cols = cols.at[..., i:i + NUM_LIMBS].add(lo[..., i, :])
+        cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(hi[..., i, :])
+    # col magnitude < 32 * 0xffff < 2^21
+
+    m_limbs = ctx.m_limbs
+    nprime = jnp.uint32(ctx.nprime)
+    carry = jnp.zeros(batch, dtype=jnp.uint32)
+    for i in range(NUM_LIMBS):
+        v = cols[..., i] + carry
+        mfac = ((v & MASK) * nprime) & MASK
+        mp = mfac[..., None] * m_limbs  # (...,16) < 2^32
+        mlo = mp & MASK
+        mhi = mp >> LIMB_BITS
+        carry = (v + mlo[..., 0]) >> LIMB_BITS
+        cols = cols.at[..., i + 1:i + NUM_LIMBS].add(mlo[..., 1:])
+        cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(mhi)
+        # per step adds < 2*0xffff + small carry; total stays < 2^22
+
+    # Result = cols[16..32] + reduction carry folded into column 16; value is
+    # < 2m (standard Montgomery bound), so one conditional subtract suffices.
+    cols_hi = cols[..., NUM_LIMBS:].at[..., 0].add(carry)
+    res, topcarry = _carry_chain(cols_hi[..., :NUM_LIMBS], NUM_LIMBS)
+    top = cols_hi[..., NUM_LIMBS] + topcarry  # 0 or 1 (value < 2m < 2^257)
+    diff, borrow = _sub_limbs(res, m_limbs)
+    use_diff = (borrow == 0) | (top > 0)
+    return jnp.where(use_diff[..., None], diff, res)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def mont_sqr(a, ctx: ModCtx = FP):
+    return mont_mul(a, a, ctx)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def to_mont(a, ctx: ModCtx = FP):
+    return mont_mul(a, ctx.r2_limbs, ctx)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def from_mont(a, ctx: ModCtx = FP):
+    one = jnp.zeros((NUM_LIMBS,), dtype=jnp.uint32).at[0].set(1)
+    return mont_mul(a, one, ctx)
+
+
+def _exp_bits(e: int, nbits: int) -> np.ndarray:
+    return np.asarray([(e >> i) & 1 for i in range(nbits)], dtype=np.uint32)
+
+
+@partial(jax.jit, static_argnames=("e", "ctx", "nbits"))
+def pow_const(a, e: int, ctx: ModCtx = FP, nbits: int = 256):
+    """a^e mod m for a STATIC exponent e, via right-to-left scan over bits.
+
+    a in Montgomery form; result in Montgomery form.
+    """
+    bits = jnp.asarray(_exp_bits(e, nbits))
+    one = jnp.broadcast_to(ctx.one_mont, a.shape)
+
+    def step(state, bit):
+        acc, base = state
+        acc2 = mont_mul(acc, base, ctx)
+        acc = jnp.where(bit == 1, acc2, acc)  # scalar cond broadcasts
+        base = mont_sqr(base, ctx)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (one, a), bits)
+    return acc
+
+
+@partial(jax.jit, static_argnames="ctx")
+def inv(a, ctx: ModCtx = FP):
+    """a^(m-2) mod m (Fermat). a in Montgomery form. inv(0) = 0."""
+    return pow_const(a, ctx.modulus - 2, ctx)
+
+
+@partial(jax.jit, static_argnames="ctx")
+def reduce_512(hi, lo, ctx: ModCtx = FP):
+    """(hi*2^256 + lo) mod m, both 16-limb plain (non-Montgomery) values.
+
+    Used for near-uniform random scalars: 512 random bits mod n has bias
+    ~2^-256. hi*2^256 mod m = mont_mul(hi, R2) (since mont_mul multiplies by
+    R^-1); then add (lo mod m).
+    """
+    hi_part = mont_mul(hi, ctx.r2_limbs, ctx)  # = hi * R mod m... see below
+    # mont_mul(hi, R2) = hi*R2*R^-1 = hi*R mod m = hi*2^256 mod m. Correct.
+    lo_norm = _cond_sub_m(lo, ctx)
+    return add(hi_part, lo_norm, ctx)
+
+
+__all__ = [
+    "ModCtx", "FP", "FN", "MASK",
+    "from_int", "to_int",
+    "add", "sub", "neg", "is_zero", "eq",
+    "mont_mul", "mont_sqr", "to_mont", "from_mont",
+    "pow_const", "inv", "reduce_512",
+]

@@ -1,0 +1,82 @@
+"""Device field kernels vs the pure-Python oracle (kernel-vs-bigint parity).
+
+Test strategy per SURVEY.md §4: every device op gets a clear-text twin.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from drynx_tpu.crypto import field as F
+from drynx_tpu.crypto import params
+
+P, N = params.P, params.N
+
+
+def _rand_ints(rng, n, mod):
+    return [rng.randrange(mod) for _ in range(n)]
+
+
+@pytest.mark.parametrize("ctx,mod", [(F.FP, P), (F.FN, N)])
+def test_add_sub_neg(ctx, mod):
+    rng = random.Random(10)
+    a = _rand_ints(rng, 32, mod)
+    b = _rand_ints(rng, 32, mod)
+    A, Bv = F.from_int(a), F.from_int(b)
+    assert list(F.to_int(F.add(A, Bv, ctx))) == [(x + y) % mod for x, y in zip(a, b)]
+    assert list(F.to_int(F.sub(A, Bv, ctx))) == [(x - y) % mod for x, y in zip(a, b)]
+    assert list(F.to_int(F.neg(A, ctx))) == [(-x) % mod for x in a]
+    # edge cases
+    edge = [0, 1, mod - 1, mod - 2]
+    E = F.from_int(edge)
+    assert list(F.to_int(F.add(E, E, ctx))) == [(x + x) % mod for x in edge]
+    assert list(F.to_int(F.sub(E, E[::-1], ctx))) == [
+        (x - y) % mod for x, y in zip(edge, edge[::-1])]
+
+
+@pytest.mark.parametrize("ctx,mod", [(F.FP, P), (F.FN, N)])
+def test_mont_mul(ctx, mod):
+    rng = random.Random(11)
+    a = _rand_ints(rng, 64, mod) + [0, 1, mod - 1]
+    b = _rand_ints(rng, 64, mod) + [mod - 1, 0, mod - 1]
+    Am = F.to_mont(F.from_int(a), ctx)
+    Bm = F.to_mont(F.from_int(b), ctx)
+    got = list(F.to_int(F.from_mont(F.mont_mul(Am, Bm, ctx), ctx)))
+    assert got == [x * y % mod for x, y in zip(a, b)]
+
+
+def test_mont_roundtrip_and_one():
+    rng = random.Random(12)
+    a = _rand_ints(rng, 16, P)
+    Am = F.to_mont(F.from_int(a))
+    assert list(F.to_int(F.from_mont(Am))) == a
+    # one_mont is identity element
+    prod = F.mont_mul(Am, F.FP.one_mont)
+    assert list(F.to_int(F.from_mont(prod))) == a
+
+
+def test_pow_and_inv():
+    rng = random.Random(13)
+    a = _rand_ints(rng, 8, P)
+    Am = F.to_mont(F.from_int(a))
+    e = rng.randrange(P)
+    got = list(F.to_int(F.from_mont(F.pow_const(Am, e))))
+    assert got == [pow(x, e, P) for x in a]
+    got_inv = list(F.to_int(F.from_mont(F.inv(Am))))
+    assert got_inv == [pow(x, P - 2, P) for x in a]
+
+
+def test_reduce_512():
+    rng = random.Random(14)
+    vals = [rng.randrange(1 << 512) for _ in range(16)]
+    hi = F.from_int([v >> 256 for v in vals])
+    lo = F.from_int([v & ((1 << 256) - 1) for v in vals])
+    got = list(F.to_int(F.reduce_512(hi, lo, F.FN)))
+    assert got == [v % N for v in vals]
+
+
+def test_is_zero_eq():
+    a = F.from_int([0, 1, P - 1])
+    z = np.asarray(F.is_zero(a))
+    assert list(z) == [True, False, False]
+    assert bool(F.eq(a[1], a[1])) and not bool(F.eq(a[1], a[2]))
