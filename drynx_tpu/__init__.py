@@ -1,0 +1,12 @@
+"""drynx_tpu — TPU-native decentralized, privacy-preserving, verifiable
+statistical-query and ML-training framework (capabilities of cgrigis/drynx,
+re-designed for JAX/XLA/Pallas/pjit).
+
+64-bit integer support is required for exact statistics vectors and limb
+packing (the crypto path itself is pure uint32 limb math); float kernels in
+the training path explicitly request float32/bfloat16, so enabling x64 here
+does not put float64 on the TPU hot path.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)

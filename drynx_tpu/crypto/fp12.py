@@ -1,0 +1,129 @@
+"""Batched Fp12 = Fp2[w]/(w^6 - XI) arithmetic (flat sextic extension).
+
+Element representation: uint32 (..., 6, 2, 16) — six Fp2 coefficients of
+w^0..w^5, Montgomery limbs. Matches refimpl.py's oracle tower.
+
+Inversion uses the quadratic-over-cubic tower view
+Fp12 = Fp6[w]/(w^2 - v), Fp6 = Fp2[v]/(v^3 - XI) with the flat coefficient
+split a = (c0, c2, c4), b = (c1, c3, c5): 1/(a + w b) = (a - w b)/(a^2 - v b^2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fp2 as F2
+from .params import NUM_LIMBS
+
+
+def from_ref(x) -> np.ndarray:
+    return np.stack([F2.from_ref(c) for c in x])
+
+
+def to_ref(x):
+    return tuple(F2.to_ref(x[..., k, :, :]) for k in range(6))
+
+
+def one(batch_shape=()):
+    o = jnp.concatenate([F2.one()[None], jnp.zeros((5, 2, NUM_LIMBS),
+                                                   dtype=jnp.uint32)])
+    return jnp.broadcast_to(o, batch_shape + (6, 2, NUM_LIMBS))
+
+
+def mul(a, b):
+    """Schoolbook 6x6 over Fp2 with w^6 = XI folding (36 Fp2 muls)."""
+    cs = [None] * 11
+    for j in range(6):
+        for k in range(6):
+            t = F2.mul(a[..., j, :, :], b[..., k, :, :])
+            cs[j + k] = t if cs[j + k] is None else F2.add(cs[j + k], t)
+    out = list(cs[:6])
+    for k in range(6, 11):
+        out[k - 6] = F2.add(out[k - 6], F2.mul_xi(cs[k]))
+    return jnp.stack(out, axis=-3)
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def conj6(a):
+    """a^(p^6): negate odd-w coefficients."""
+    out = [a[..., k, :, :] if k % 2 == 0 else F2.neg(a[..., k, :, :])
+           for k in range(6)]
+    return jnp.stack(out, axis=-3)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2, -3))
+
+
+# ---------------------------------------------------------------------------
+# Fp6 helpers on coefficient triples (tuples of (..., 2, 16) Fp2 elements)
+# ---------------------------------------------------------------------------
+
+def _fp6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t00 = F2.mul(a0, b0)
+    t11 = F2.mul(a1, b1)
+    t22 = F2.mul(a2, b2)
+    c0 = F2.add(t00, F2.mul_xi(F2.add(F2.mul(a1, b2), F2.mul(a2, b1))))
+    c1 = F2.add(F2.add(F2.mul(a0, b1), F2.mul(a1, b0)),
+                F2.mul_xi(t22))
+    c2 = F2.add(F2.add(F2.mul(a0, b2), F2.mul(a2, b0)), t11)
+    return (c0, c1, c2)
+
+
+def _fp6_sub(a, b):
+    return tuple(F2.sub(x, y) for x, y in zip(a, b))
+
+
+def _fp6_mul_v(a):
+    """Multiply by v: (a0, a1, a2) -> (XI*a2, a0, a1)."""
+    return (F2.mul_xi(a[2]), a[0], a[1])
+
+
+def _fp6_inv(a):
+    a0, a1, a2 = a
+    c0 = F2.sub(F2.sqr(a0), F2.mul_xi(F2.mul(a1, a2)))
+    c1 = F2.sub(F2.mul_xi(F2.sqr(a2)), F2.mul(a0, a1))
+    c2 = F2.sub(F2.sqr(a1), F2.mul(a0, a2))
+    t = F2.add(F2.mul(a0, c0),
+               F2.mul_xi(F2.add(F2.mul(a1, c2), F2.mul(a2, c1))))
+    ti = F2.inv(t)
+    return (F2.mul(c0, ti), F2.mul(c1, ti), F2.mul(c2, ti))
+
+
+def inv(f):
+    """Tower inversion: f = a(v) + w*b(v), v = w^2."""
+    a = (f[..., 0, :, :], f[..., 2, :, :], f[..., 4, :, :])
+    b = (f[..., 1, :, :], f[..., 3, :, :], f[..., 5, :, :])
+    norm = _fp6_sub(_fp6_mul(a, a), _fp6_mul_v(_fp6_mul(b, b)))
+    ninv = _fp6_inv(norm)
+    ra = _fp6_mul(a, ninv)
+    rb = _fp6_mul(b, ninv)
+    rb = tuple(F2.neg(x) for x in rb)
+    return jnp.stack([ra[0], rb[0], ra[1], rb[1], ra[2], rb[2]], axis=-3)
+
+
+def pow_const(f, e: int):
+    """f^e for a STATIC exponent via scan (LSB-first double-and-multiply)."""
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], dtype=jnp.uint32)
+    acc0 = one(f.shape[:-3])
+
+    def step(state, bit):
+        acc, base = state
+        acc2 = mul(acc, base)
+        acc = jnp.where(bit == 1, acc2, acc)
+        base = sqr(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (acc0, f), bits)
+    return acc
+
+
+__all__ = ["from_ref", "to_ref", "one", "mul", "sqr", "conj6", "eq", "inv",
+           "pow_const"]

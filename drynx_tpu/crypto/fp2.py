@@ -1,0 +1,132 @@
+"""Batched Fp2 = Fp[i]/(i^2+1) arithmetic on limb tensors.
+
+Element representation: uint32 (..., 2, 16) = (a0, a1) Montgomery limbs.
+Mirrors the tower choices in params.py / refimpl.py (our own suite — only
+internal consistency is required, reference fixes bn256 via kyber at
+lib/suite.go:10-20).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import field as F
+from . import params
+from .field import FP
+from .params import NUM_LIMBS
+
+
+def from_ref(a) -> np.ndarray:
+    """Oracle (a0, a1) ints -> (2, 16) Montgomery limbs."""
+    mont = lambda v: params.to_limbs(v * params.R % params.P)
+    return np.asarray([mont(a[0] % params.P), mont(a[1] % params.P)],
+                      dtype=np.uint32)
+
+
+def to_ref(x):
+    a = np.asarray(F.to_int(np.asarray(F.from_mont(jnp.asarray(x), FP))))
+    if a.ndim == 1:
+        return (int(a[0]), int(a[1]))
+    return a  # (..., 2) object array
+
+
+ZERO = jnp.zeros((2, NUM_LIMBS), dtype=jnp.uint32)
+
+
+def one():
+    return jnp.stack([FP.one_mont, FP.zero])
+
+
+def add(a, b):
+    return F.add(a, b, FP)
+
+
+def sub(a, b):
+    return F.sub(a, b, FP)
+
+
+def neg(a):
+    return F.neg(a, FP)
+
+
+def mul(a, b):
+    """Karatsuba: 3 Fp mults."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = F.mont_mul(a0, b0, FP)
+    t1 = F.mont_mul(a1, b1, FP)
+    t2 = F.mont_mul(F.add(a0, a1, FP), F.add(b0, b1, FP), FP)
+    r0 = F.sub(t0, t1, FP)
+    r1 = F.sub(F.sub(t2, t0, FP), t1, FP)
+    return jnp.stack([r0, r1], axis=-2)
+
+
+def sqr(a):
+    """(a0+a1 i)^2 = (a0+a1)(a0-a1) + 2 a0 a1 i — 2 Fp mults."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    r0 = F.mont_mul(F.add(a0, a1, FP), F.sub(a0, a1, FP), FP)
+    t = F.mont_mul(a0, a1, FP)
+    r1 = F.add(t, t, FP)
+    return jnp.stack([r0, r1], axis=-2)
+
+
+def mul_fp(a, s):
+    """Multiply by an Fp element s (..., 16)."""
+    return jnp.stack([F.mont_mul(a[..., 0, :], s, FP),
+                      F.mont_mul(a[..., 1, :], s, FP)], axis=-2)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small int constant via repeated adds."""
+    out = a
+    for _ in range(k - 1):
+        out = add(out, a)
+    return out
+
+
+def conj(a):
+    return jnp.stack([a[..., 0, :], F.neg(a[..., 1, :], FP)], axis=-2)
+
+
+def inv(a):
+    """1/(a0+a1 i) = (a0 - a1 i)/(a0^2 + a1^2)."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = F.add(F.mont_mul(a0, a0, FP), F.mont_mul(a1, a1, FP), FP)
+    ninv = F.inv(norm, FP)
+    return jnp.stack([F.mont_mul(a0, ninv, FP),
+                      F.neg(F.mont_mul(a1, ninv, FP), FP)], axis=-2)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2))
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+# Device constant: XI (the sextic non-residue defining Fp12 and the twist)
+XI_DEV = jnp.asarray(from_ref(params.XI))
+
+
+def mul_xi(a):
+    """Multiply by XI = (xi0 + i). With XI = (x, 1):
+    (a0+a1 i)(x+i) = (x a0 - a1) + (a0 + x a1) i."""
+    x0, x1 = params.XI
+    assert x1 == 1
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    if x0 == 1:
+        r0 = F.sub(a0, a1, FP)
+        r1 = F.add(a0, a1, FP)
+    else:
+        xs = jnp.asarray(params.to_limbs(x0 * params.R % params.P),
+                         dtype=jnp.uint32)
+        r0 = F.sub(F.mont_mul(a0, xs, FP), a1, FP)
+        r1 = F.add(a0, F.mont_mul(a1, xs, FP), FP)
+    return jnp.stack([r0, r1], axis=-2)
+
+
+__all__ = ["from_ref", "to_ref", "ZERO", "one", "add", "sub", "neg", "mul",
+           "sqr", "mul_fp", "mul_small", "conj", "inv", "eq", "is_zero",
+           "XI_DEV", "mul_xi"]

@@ -1,0 +1,164 @@
+"""Batched G2 (sextic twist E'(Fp2): y^2 = x^3 + 3/XI) group ops.
+
+Point representation: uint32 (..., 3, 2, 16) = (X, Y, Z) Jacobian coords,
+each an Fp2 element in Montgomery form; infinity has Z == 0.
+
+Used by the range-proof layer: Boneh–Boyen signatures A[k] = (x+k)^-1·B2 live
+in G2 and are randomized per proof (V = v·A[digit], reference
+lib/range/range_proof.go:392-394).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fp2 as F2
+from . import field as F
+from . import params, refimpl
+from .params import NUM_LIMBS
+
+
+def from_ref(pt) -> np.ndarray:
+    """Oracle twist point ((x0,x1),(y0,y1)) or None -> (3, 2, 16) limbs."""
+    if pt is None:
+        x, y, z = (1, 0), (1, 0), (0, 0)
+    else:
+        x, y = pt
+        z = (1, 0)
+    return np.stack([F2.from_ref(x), F2.from_ref(y), F2.from_ref(z)])
+
+
+def to_ref(pt):
+    x, y, inf = normalize(jnp.asarray(pt))
+    if np.asarray(inf).ndim == 0:
+        if bool(inf):
+            return None
+        return (F2.to_ref(x), F2.to_ref(y))
+    raise NotImplementedError("batched to_ref: map over leading axis")
+
+
+def infinity(batch_shape=()):
+    base = jnp.asarray(from_ref(None))
+    return jnp.broadcast_to(base, batch_shape + (3, 2, NUM_LIMBS))
+
+
+G2_GEN = jnp.asarray(from_ref(refimpl.G2))
+
+
+def is_infinity(p):
+    return F2.is_zero(p[..., 2, :, :])
+
+
+@jax.jit
+def double(p):
+    X, Y, Z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    A = F2.sqr(X)
+    B = F2.sqr(Y)
+    C = F2.sqr(B)
+    t = F2.sub(F2.sqr(F2.add(X, B)), F2.add(A, C))
+    D = F2.add(t, t)
+    E = F2.add(F2.add(A, A), A)
+    Fv = F2.sqr(E)
+    X3 = F2.sub(Fv, F2.add(D, D))
+    C8 = F2.mul_small(C, 8)
+    Y3 = F2.sub(F2.mul(E, F2.sub(D, X3)), C8)
+    YZ = F2.mul(Y, Z)
+    Z3 = F2.add(YZ, YZ)
+    return jnp.stack([X3, Y3, Z3], axis=-3)
+
+
+@jax.jit
+def add(p, q):
+    X1, Y1, Z1 = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    X2, Y2, Z2 = q[..., 0, :, :], q[..., 1, :, :], q[..., 2, :, :]
+
+    Z1Z1 = F2.sqr(Z1)
+    Z2Z2 = F2.sqr(Z2)
+    U1 = F2.mul(X1, Z2Z2)
+    U2 = F2.mul(X2, Z1Z1)
+    S1 = F2.mul(Y1, F2.mul(Z2, Z2Z2))
+    S2 = F2.mul(Y2, F2.mul(Z1, Z1Z1))
+    H = F2.sub(U2, U1)
+    HH = F2.add(H, H)
+    I = F2.sqr(HH)
+    J = F2.mul(H, I)
+    r = F2.sub(S2, S1)
+    r = F2.add(r, r)
+    V = F2.mul(U1, I)
+    X3 = F2.sub(F2.sub(F2.sqr(r), J), F2.add(V, V))
+    SJ = F2.mul(S1, J)
+    Y3 = F2.sub(F2.mul(r, F2.sub(V, X3)), F2.add(SJ, SJ))
+    ZZ = F2.sub(F2.sub(F2.sqr(F2.add(Z1, Z2)), Z1Z1), Z2Z2)
+    Z3 = F2.mul(ZZ, H)
+    res_add = jnp.stack([X3, Y3, Z3], axis=-3)
+
+    res_dbl = double(p)
+
+    p_inf = is_infinity(p)
+    q_inf = is_infinity(q)
+    h_zero = F2.is_zero(H)
+    r_zero = F2.is_zero(r)
+
+    sel = lambda c, t, f: jnp.where(c[..., None, None, None], t, f)
+    out = sel(h_zero & r_zero & ~p_inf & ~q_inf, res_dbl, res_add)
+    out = sel(h_zero & ~r_zero & ~p_inf & ~q_inf,
+              infinity(out.shape[:-3]), out)
+    out = sel(q_inf, p, out)
+    out = sel(p_inf, q, out)
+    return out
+
+
+@jax.jit
+def neg(p):
+    return p.at[..., 1, :, :].set(F2.neg(p[..., 1, :, :]))
+
+
+@jax.jit
+def scalar_mul(p, k_limbs):
+    """k * Q, 256-step double-and-add-always scan (k: plain limbs (..., 16))."""
+    bits = (k_limbs[..., :, None] >> jnp.arange(params.LIMB_BITS, dtype=jnp.uint32)) & 1
+    bits = bits.reshape(bits.shape[:-2] + (256,))
+    bits_t = jnp.moveaxis(bits, -1, 0)
+
+    batch = jnp.broadcast_shapes(p.shape[:-3], k_limbs.shape[:-1])
+    acc0 = infinity(batch)
+    base0 = jnp.broadcast_to(p, batch + (3, 2, NUM_LIMBS))
+
+    def step(state, bit):
+        acc, base = state
+        acc2 = add(acc, base)
+        acc = jnp.where(bit[..., None, None, None] == 1, acc2, acc)
+        base = double(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (acc0, base0), bits_t)
+    return acc
+
+
+@jax.jit
+def normalize(p):
+    """Jacobian -> affine (x, y Fp2 Montgomery limbs, is_inf)."""
+    X, Y, Z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    inf = is_infinity(p)
+    Zsafe = jnp.where(inf[..., None, None], F2.one(), Z)
+    Zi = F2.inv(Zsafe)
+    Zi2 = F2.sqr(Zi)
+    x = F2.mul(X, Zi2)
+    y = F2.mul(Y, F2.mul(Zi, Zi2))
+    return x, y, inf
+
+
+@jax.jit
+def eq(p, q):
+    X1, Y1, Z1 = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    X2, Y2, Z2 = q[..., 0, :, :], q[..., 1, :, :], q[..., 2, :, :]
+    Z1Z1, Z2Z2 = F2.sqr(Z1), F2.sqr(Z2)
+    same_x = F2.eq(F2.mul(X1, Z2Z2), F2.mul(X2, Z1Z1))
+    same_y = F2.eq(F2.mul(Y1, F2.mul(Z2, Z2Z2)), F2.mul(Y2, F2.mul(Z1, Z1Z1)))
+    p_inf, q_inf = is_infinity(p), is_infinity(q)
+    return (p_inf & q_inf) | (~p_inf & ~q_inf & same_x & same_y)
+
+
+__all__ = ["from_ref", "to_ref", "infinity", "G2_GEN", "is_infinity",
+           "double", "add", "neg", "scalar_mul", "normalize", "eq"]

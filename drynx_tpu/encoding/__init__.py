@@ -1,0 +1,15 @@
+"""Query-operation encoders: local data -> sufficient-statistics vectors.
+
+TPU-first re-design of the reference's lib/encoding package (dispatcher at
+lib/encoding/encode_decode.go:14-233): every operation's local encoding is a
+fixed-shape vectorized reduction producing an int64 statistics vector whose
+length depends only on the query (never the data), so the whole DP-side
+pipeline (encode -> encrypt -> aggregate) is one jittable program.
+"""
+from .stats import (  # noqa: F401
+    OPS,
+    DecryptedVector,
+    decode,
+    encode_clear,
+    output_size,
+)

@@ -1,0 +1,242 @@
+"""Sufficient-statistics encoders/decoders for the 12 statistical query ops.
+
+Reference semantics (lib/encoding/*.go, see SURVEY.md §2.1 #3-13):
+
+  sum        [Σx]                                  sum.go:17-36
+  mean       [Σx, N]                               mean.go:17-59
+  variance   [Σx, N, Σx²]                          variance.go:17-61
+  cosim      [Σa, Σb, Σa², Σb², Σab]               cosim.go:18-70
+  bool_OR    [bit]   (zero iff false)              OR_AND.go:23-59
+  bool_AND   [1-bit] (agg zero iff all true)       OR_AND.go:76-112
+  min        OR-bits  b_i = (i >= local_min)       min_max.go:13-55
+  max        AND-bits b_i = (i >= local_max)       min_max.go:87-123
+  frequency_count  histogram over [min,max]        frequency_count.go:18-62
+  union      OR presence bits over [min,max]       set_union_intersection.go:19
+  inter      AND presence bits over [min,max]      set_union_intersection.go:94
+  lin_reg    [N, ΣXj, ΣXjXk(uptri), ΣY, ΣXjY]      linear_regression_dims.go:23-110
+  r2         [N, ΣY, ΣY², Σ(pred−y)²]              model_evaluation.go:17-81
+
+AND-semantics ops encode the COMPLEMENT bit so that the homomorphic sum is
+zero iff every DP's bit is one — the zero/nonzero property survives the
+obfuscation protocol's random scalar multiplications (reference
+protocols/obfuscation_protocol.go:241-243), exactly like the reference's
+proof-mode 0/1 encodings. Non-proof mode scales bits by a local random
+nonzero value (OR_AND.go:23-40); here that is the optional `bit_scale`.
+
+Decoding consumes a `DecryptedVector` carrying both integer values (discrete
+log) and zero-flags, because OR/AND-family results only need (and after
+obfuscation only HAVE) the zero/nonzero bit (unlynx DecryptCheckZero,
+reference lib/encoding/OR_AND.go:61,114).
+"""
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class DecryptedVector:
+    """Decrypted query result: ints where resolvable + zero-flags always."""
+
+    values: np.ndarray   # int64 (nbr_output,) — valid where `found`
+    found: np.ndarray    # bool  (nbr_output,)
+    is_zero: np.ndarray  # bool  (nbr_output,)
+
+
+# ---------------------------------------------------------------------------
+# Output sizing (reference lib/structs.go:591-641 ChooseOperation)
+# ---------------------------------------------------------------------------
+
+def output_size(op: str, query_min: int = 0, query_max: int = 0,
+                dims: int = 1) -> int:
+    rng = query_max - query_min + 1
+    return {
+        "sum": 1,
+        "mean": 2,
+        "variance": 3,
+        "cosim": 5,
+        "bool_OR": 1,
+        "bool_AND": 1,
+        "min": rng,
+        "max": rng,
+        "frequency_count": rng,
+        "union": rng,
+        "inter": rng,
+        "lin_reg": (dims * dims + 5 * dims + 4) // 2,
+        "r2": 4,
+    }[op]
+
+
+# ---------------------------------------------------------------------------
+# Clear-text local encoders (jit-safe; int64 in/out)
+# ---------------------------------------------------------------------------
+
+def _bits_ge(local: jnp.ndarray, lo: int, hi: int) -> jnp.ndarray:
+    """(hi-lo+1,) bits b_i = (i >= local) for i in [lo, hi]."""
+    grid = jnp.arange(lo, hi + 1, dtype=jnp.int64)
+    return (grid >= local).astype(jnp.int64)
+
+
+def _presence(xs: jnp.ndarray, lo: int, hi: int) -> jnp.ndarray:
+    grid = jnp.arange(lo, hi + 1, dtype=jnp.int64)
+    return jnp.any(xs[:, None] == grid[None, :], axis=0).astype(jnp.int64)
+
+
+def encode_clear(op: str, data, query_min: int = 0, query_max: int = 0,
+                 preds=None, bit_scale=None):
+    """Local sufficient statistics for one DP. `data`: int64 (rows,) or
+    (rows, cols) for cosim (2 cols) / lin_reg (d features + label last).
+    `preds`: model predictions for r2. `bit_scale`: optional random nonzero
+    int64 multiplier for OR/AND-family encodings (non-proof mode)."""
+    x = jnp.asarray(data, dtype=jnp.int64)
+    s = jnp.int64(1) if bit_scale is None else jnp.asarray(bit_scale, jnp.int64)
+
+    if op == "sum":
+        return jnp.sum(x)[None]
+    if op == "mean":
+        return jnp.stack([jnp.sum(x), jnp.int64(x.shape[0])])
+    if op == "variance":
+        return jnp.stack([jnp.sum(x), jnp.int64(x.shape[0]), jnp.sum(x * x)])
+    if op == "cosim":
+        a, b = x[:, 0], x[:, 1]
+        return jnp.stack([jnp.sum(a), jnp.sum(b), jnp.sum(a * a),
+                          jnp.sum(b * b), jnp.sum(a * b)])
+    if op == "bool_OR":
+        bit = jnp.any(x != 0).astype(jnp.int64)
+        return (bit * s)[None]
+    if op == "bool_AND":
+        bit = jnp.all(x != 0).astype(jnp.int64)
+        return ((1 - bit) * s)[None]
+    if op == "min":
+        return _bits_ge(jnp.min(x), query_min, query_max) * s
+    if op == "max":
+        return (1 - _bits_ge(jnp.max(x), query_min, query_max)) * s
+    if op == "frequency_count":
+        grid = jnp.arange(query_min, query_max + 1, dtype=jnp.int64)
+        return jnp.sum(x[:, None] == grid[None, :], axis=0).astype(jnp.int64)
+    if op == "union":
+        return _presence(x, query_min, query_max) * s
+    if op == "inter":
+        return (1 - _presence(x, query_min, query_max)) * s
+    if op == "lin_reg":
+        X, y = x[:, :-1], x[:, -1]
+        d = X.shape[1]
+        n = jnp.int64(X.shape[0])
+        sx = jnp.sum(X, axis=0)
+        outer = X.T @ X  # (d, d)
+        iu, ju = np.triu_indices(d)
+        sxx = outer[iu, ju]
+        sy = jnp.sum(y)[None]
+        sxy = X.T @ y
+        return jnp.concatenate([n[None], sx, sxx, sy, sxy])
+    if op == "r2":
+        y = x
+        p = jnp.asarray(preds, dtype=jnp.int64)
+        err = p - y
+        return jnp.stack([jnp.int64(y.shape[0]), jnp.sum(y),
+                          jnp.sum(y * y), jnp.sum(err * err)])
+    raise ValueError(f"unknown operation {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# Decoders (host-side; exact rational arithmetic where the reference is exact)
+# ---------------------------------------------------------------------------
+
+def _first_nonzero(flags_nonzero, lo: int):
+    idx = np.flatnonzero(flags_nonzero)
+    return None if idx.size == 0 else lo + int(idx[0])
+
+
+def decode(op: str, dec: DecryptedVector, query_min: int = 0,
+           query_max: int = 0, dims: int = 1):
+    v = np.asarray(dec.values, dtype=np.int64)
+    nz = ~np.asarray(dec.is_zero)
+
+    if op == "sum":
+        return int(v[0])
+    if op == "mean":
+        return float(v[0]) / float(v[1])
+    if op == "variance":
+        s, n, ss = (int(v[0]), int(v[1]), int(v[2]))
+        mean = s / n
+        return ss / n - mean * mean
+    if op == "cosim":
+        sa, sb, saa, sbb, sab = (int(t) for t in v)
+        return sab / (np.sqrt(saa) * np.sqrt(sbb))
+    if op == "bool_OR":
+        return bool(nz[0])
+    if op == "bool_AND":
+        return not bool(nz[0])
+    if op == "min":
+        return _first_nonzero(nz, query_min)
+    if op == "max":
+        # encoded complement: aggregated zero at i iff every DP max <= i
+        return _first_nonzero(~nz, query_min)
+    if op == "frequency_count":
+        return {query_min + i: int(c) for i, c in enumerate(v)}
+    if op == "union":
+        return [query_min + i for i in np.flatnonzero(nz)]
+    if op == "inter":
+        return [query_min + i for i in np.flatnonzero(~nz)]
+    if op == "lin_reg":
+        return _decode_linreg(v, dims)
+    if op == "r2":
+        n, sy, syy, serr = (int(t) for t in v)
+        denom = Fraction(syy) - Fraction(sy * sy, n)
+        if denom == 0:
+            return 0.0
+        return float(1 - Fraction(serr) / denom)
+    raise ValueError(f"unknown operation {op!r}")
+
+
+def _decode_linreg(v: np.ndarray, d: int):
+    """Solve the normal equations exactly (rational Gaussian elimination,
+    mirroring reference linear_regression_dims.go:110-204)."""
+    n = int(v[0])
+    sx = [int(t) for t in v[1:1 + d]]
+    ntri = d * (d + 1) // 2
+    sxx_flat = [int(t) for t in v[1 + d:1 + d + ntri]]
+    sy = int(v[1 + d + ntri])
+    sxy = [int(t) for t in v[2 + d + ntri:2 + 2 * d + ntri]]
+
+    sxx = [[0] * d for _ in range(d)]
+    k = 0
+    for i in range(d):
+        for j in range(i, d):
+            sxx[i][j] = sxx[j][i] = sxx_flat[k]
+            k += 1
+
+    # Augmented (d+1)x(d+2) system for [b0, b1..bd]
+    A = [[Fraction(0)] * (d + 2) for _ in range(d + 1)]
+    A[0][0] = Fraction(n)
+    for j in range(d):
+        A[0][j + 1] = A[j + 1][0] = Fraction(sx[j])
+    for i in range(d):
+        for j in range(d):
+            A[i + 1][j + 1] = Fraction(sxx[i][j])
+    A[0][d + 1] = Fraction(sy)
+    for i in range(d):
+        A[i + 1][d + 1] = Fraction(sxy[i])
+
+    m = d + 1
+    for col in range(m):
+        piv = next((r for r in range(col, m) if A[r][col] != 0), None)
+        if piv is None:
+            return None  # singular system
+        A[col], A[piv] = A[piv], A[col]
+        pv = A[col][col]
+        A[col] = [a / pv for a in A[col]]
+        for r in range(m):
+            if r != col and A[r][col] != 0:
+                f = A[r][col]
+                A[r] = [a - f * b for a, b in zip(A[r], A[col])]
+    return np.asarray([float(A[r][m]) for r in range(m)])
+
+
+OPS = ["sum", "mean", "variance", "cosim", "bool_OR", "bool_AND", "min",
+       "max", "frequency_count", "union", "inter", "lin_reg", "r2"]
+
+__all__ = ["OPS", "DecryptedVector", "encode_clear", "decode", "output_size"]

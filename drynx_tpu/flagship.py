@@ -1,0 +1,141 @@
+"""Flagship pipeline: fully-jitted encrypted logistic-regression survey.
+
+This is the TPU equivalent of the reference's north-star workload
+(SURVEY.md §3.4; reference services/service_test.go:1082-1130 — Pima, 10 DPs,
+K=2, precision 1e0, GD step 0.1): every DP encodes + encrypts its local
+approximation tensors, ciphertexts are homomorphically aggregated, the
+collective key-switches the aggregate to the querier, the querier decrypts
+(discrete-log table) and runs gradient descent — all as ONE jitted program.
+
+The same program builds two ways:
+  * single-chip (`build_pipeline`): server/DP loops become batched axes and
+    tree reductions on one device — used by bench.py and __graft_entry__.entry.
+  * multi-chip (`build_sharded_pipeline`): DPs/servers ride a mesh axis with
+    butterfly all-reduces (drynx_tpu.parallel), the ciphertext vector is
+    sharded over a second mesh axis — used by __graft_entry__.dryrun_multichip.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .crypto import curve as C
+from .crypto import elgamal as eg
+from .crypto import field as F
+from .models import logreg as lr
+from .parallel import collective as col
+
+
+@dataclasses.dataclass
+class SurveySetup:
+    """Keys + tables for one survey: n_servers CNs, a querier, 10 DPs."""
+
+    server_secrets: np.ndarray    # (n_servers, 16) scalar limbs
+    coll_pub_table: jnp.ndarray   # (64, 16, 3, 16) fixed-base table
+    query_secret: int
+    query_pub_table: jnp.ndarray
+    dlog: eg.DecryptionTable
+
+    @classmethod
+    def create(cls, n_servers: int = 3, dlog_limit: int = 10000, seed: int = 4):
+        rng = np.random.default_rng(seed)
+        secrets, pubs = zip(*[eg.keygen(rng) for _ in range(n_servers)])
+        coll = col.collective_key(pubs)
+        qx, qpub = eg.keygen(rng)
+        return cls(
+            server_secrets=np.stack([eg.secret_to_limbs(x) for x in secrets]),
+            coll_pub_table=eg.pub_table(coll).table,
+            query_secret=qx,
+            query_pub_table=eg.pub_table(qpub).table,
+            dlog=eg.DecryptionTable(limit=dlog_limit),
+        )
+
+
+def _tree_reduce_points(pts):
+    """Reduce axis 0 of a point/ct tensor by repeated halving (log2 depth)."""
+    n = pts.shape[0]
+    while n > 1:
+        half = n // 2
+        even = pts[: 2 * half : 2]
+        odd = pts[1 : 2 * half : 2]
+        red = C.add(even, odd)
+        if n % 2:
+            red = jnp.concatenate([red, pts[-1:]], axis=0)
+        pts = red
+        n = pts.shape[0]
+    return pts[0]
+
+
+def build_pipeline(setup: SurveySetup, params: lr.LRParams):
+    """Single-chip jitted survey step.
+
+    Returns fn(dp_stats, enc_rs, ks_rs) -> (weights, dec_ints, found):
+      dp_stats: int64 (n_dps, V) local fixed-point stat vectors
+      enc_rs:   uint32 (n_dps, V, 16) encryption blinding scalars
+      ks_rs:    uint32 (n_servers, V, 16) key-switch randomness
+    """
+    base_tbl = eg.BASE_TABLE.table
+    coll_tbl = setup.coll_pub_table
+    q_tbl = setup.query_pub_table
+    srv_x = jnp.asarray(setup.server_secrets)
+    qx = jnp.asarray(eg.secret_to_limbs(setup.query_secret))
+    dl = setup.dlog
+    keys, xs, ysign, vals = dl.keys, dl.xs, dl.ysign, dl.vals
+
+    def fn(dp_stats, enc_rs, ks_rs):
+        # DP-side: encrypt every stat of every DP (one big batch).
+        m = eg.int_to_scalar(dp_stats)
+        cts = eg.encrypt_with_tables(base_tbl, coll_tbl, m, enc_rs)
+        # Collective aggregation (CN tree -> on-chip tree reduce).
+        agg = _tree_reduce_points(cts)
+        # Key switch: per-server contributions (vmapped), then reduce.
+        kc, cc = jax.vmap(
+            lambda x, r: col.keyswitch_contribution(agg, x, r, q_tbl)
+        )(srv_x, ks_rs)
+        switched = col.keyswitch_finish(
+            agg, _tree_reduce_points(kc), _tree_reduce_points(cc))
+        # Querier decrypt + discrete log.
+        pts = eg.decrypt_point(switched, qx)
+        dec, found = eg._table_lookup(keys, xs, ysign, vals, pts)
+        # Gradient descent on the approximated cost.
+        Ts = lr.unpack(dec, params)
+        w = lr.train(Ts, params)
+        return w, dec, found
+
+    return fn
+
+
+def make_inputs(X, y, params: lr.LRParams, num_dps: int = 10, seed: int = 0):
+    """Host-side: per-DP stats + randomness for the pipeline."""
+    stats = np.stack([
+        np.asarray(lr.encode_clear(*lr.shard_for_dp(X, y, i, num_dps), params))
+        for i in range(num_dps)
+    ])
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    V = stats.shape[1]
+    enc_rs = eg.random_scalars(k1, (num_dps, V))
+    return jnp.asarray(stats), enc_rs, k1, k2
+
+
+def pima_shaped_problem(num_dps: int = 10, n_records: int = 768, d: int = 8,
+                        max_iterations: int = 450):
+    """Pima-benchmark-shaped problem (reference TIFS/logRegV2.py setting:
+    768 records x 10 DPs, 8 features, K=2, 450 iterations)."""
+    X, y = lr.synthetic_dataset(n=n_records, d=d, seed=13)
+    X = np.tile(X, (num_dps, 1))
+    y = np.tile(y, num_dps)
+    p = lr.LRParams(
+        k=2, precision=1.0, lambda_=1.0, step=0.1,
+        max_iterations=max_iterations, n_features=d,
+        n_records=len(y),
+        means=tuple(np.mean(X, 0)), std_devs=tuple(np.std(X, 0)))
+    return X, y, p
+
+
+__all__ = ["SurveySetup", "build_pipeline", "make_inputs",
+           "pima_shaped_problem"]

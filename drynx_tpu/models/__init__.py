@@ -1,0 +1,3 @@
+"""Model families: encrypted-training logistic regression (flagship) and the
+linear-regression / model-evaluation paths built on the encoders."""
+from . import logreg  # noqa: F401

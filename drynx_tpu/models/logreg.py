@@ -1,0 +1,281 @@
+"""Encrypted logistic regression — the flagship workload.
+
+Re-design of the reference's largest component
+(lib/encoding/logistic_regression.go, 1579 LoC; see SURVEY.md §2.1 #14, §3.4):
+the log-loss is approximated by a degree-k polynomial in the margin w·x, so a
+DP's whole contribution reduces to the sign-weighted outer-power tensors
+
+    T_j = Σ_i  s_j(y_i) · x_i^{⊗j},   j = 1..k,
+    s_j(y) = 2y−1  for odd j,  −1  for even j
+    (reference ComputeAllApproxCoefficients, logistic_regression.go:367-403:
+     ypart = y − y·(−1)^j − 1 over labels y ∈ {0,1})
+
+computed here as single einsums over the record batch — the reference's
+per-record CartesianProduct loops (logistic_regression.go:383-396) become one
+MXU-friendly contraction. Training on the querier side is gradient descent on
+the polynomial cost (reference Cost/Gradient/FindMinimumWeights,
+logistic_regression.go:526-742); the hand-derived symmetric-tensor derivative
+is replaced by `jax.grad`, and the whole GD loop is one jitted
+`lax.fori_loop` — this function is the framework's flagship jittable step.
+
+Approximation coefficients (reference logistic_regression.go:30-36):
+  Taylor  : [−ln 2, −1/2, −1/8, 0, 0.0052]
+  MinArea : [−0.714761, −0.5, −0.0976419]   (default, k = 2)
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TAYLOR_COEFFS = (-math.log(2.0), -0.5, -0.125, 0.0, 0.0052)
+MIN_AREA_COEFFS = (-0.714761, -0.5, -0.0976419)
+
+
+@dataclasses.dataclass
+class LRParams:
+    """Mirror of the reference's LogisticRegressionParameters
+    (lib/structs.go:210-228)."""
+
+    k: int = 2
+    precision: float = 1e2       # PrecisionApproxCoefficients
+    lambda_: float = 1.0
+    step: float = 0.1
+    max_iterations: int = 25
+    initial_weights: tuple = ()
+    n_features: int = 0
+    n_records: int = 0
+    means: tuple | None = None    # global standardisation, optional
+    std_devs: tuple | None = None
+    coeffs: tuple = MIN_AREA_COEFFS
+
+    def num_coeffs(self) -> int:
+        dp1 = self.n_features + 1
+        return sum(dp1 ** j for j in range(1, self.k + 1))
+
+
+# ---------------------------------------------------------------------------
+# Preprocessing (reference logistic_regression.go:905-1047)
+# ---------------------------------------------------------------------------
+
+def standardise(X, means=None, std_devs=None):
+    """x' = (x − mean)/std, population std (ddof=0) like the reference's
+    montanaflynn/stats.StandardDeviation."""
+    X = jnp.asarray(X, dtype=jnp.float64)
+    mu = jnp.mean(X, axis=0) if means is None else jnp.asarray(means)
+    sd = jnp.std(X, axis=0) if std_devs is None else jnp.asarray(std_devs)
+    return (X - mu) / sd
+
+
+def normalize(X, mins=None, maxs=None):
+    X = jnp.asarray(X, dtype=jnp.float64)
+    lo = jnp.min(X, axis=0) if mins is None else jnp.asarray(mins)
+    hi = jnp.max(X, axis=0) if maxs is None else jnp.asarray(maxs)
+    return (X - lo) / (hi - lo)
+
+
+def augment(X):
+    """Prepend the all-ones offset column."""
+    X = jnp.asarray(X)
+    return jnp.concatenate([jnp.ones((X.shape[0], 1), X.dtype), X], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# DP-side encoding: approximation tensors -> fixed-point int vector
+# ---------------------------------------------------------------------------
+
+def _einsum_spec(j: int) -> str:
+    idx = "abcdefgh"[:j]
+    return "n," + ",".join(f"n{c}" for c in idx) + "->" + idx
+
+
+def approx_tensors(Xa, y, k: int):
+    """T_j for j=1..k as FLAT float arrays. Xa: augmented standardized
+    records (n, d+1); y: labels {0,1} (n,)."""
+    Xa = jnp.asarray(Xa, dtype=jnp.float64)
+    y = jnp.asarray(y, dtype=jnp.float64)
+    sign_odd = 2.0 * y - 1.0
+    out = []
+    for j in range(1, k + 1):
+        s = sign_odd if j % 2 == 1 else -jnp.ones_like(y)
+        args = [s] + [Xa] * j
+        T = jnp.einsum(_einsum_spec(j), *args)
+        out.append(T.reshape(-1))
+    return out
+
+
+def encode_clear(X, y, p: LRParams):
+    """One DP's packed int64 statistics vector (ready for encryption)."""
+    Xs = standardise(X, p.means, p.std_devs)
+    Xa = augment(Xs)
+    Ts = approx_tensors(Xa, y, p.k)
+    packed = jnp.concatenate(Ts)
+    return jnp.round(packed * p.precision).astype(jnp.int64)
+
+
+def unpack(dec_ints, p: LRParams):
+    """Decrypted aggregated ints -> per-degree float tensors (rescaled)."""
+    dp1 = p.n_features + 1
+    vals = jnp.asarray(dec_ints, dtype=jnp.float64) / p.precision
+    out, off = [], 0
+    for j in range(1, p.k + 1):
+        n = dp1 ** j
+        out.append(vals[off:off + n])
+        off += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Querier-side training (polynomial cost + autodiff GD, fully jitted)
+# ---------------------------------------------------------------------------
+
+def cost(w, Ts, N, lambda_, coeffs):
+    """Approximated, l2-regularized mean log-loss (reference Cost,
+    logistic_regression.go:526-560 — with the per-degree coefficients
+    applied independently, as the reference's Gradient does)."""
+    dp1 = w.shape[0]
+    c = jnp.float64(0.0)
+    for j, Tf in enumerate(Ts, start=1):
+        contr = Tf.reshape((dp1,) * j)
+        for _ in range(j):
+            contr = jnp.tensordot(contr, w, axes=([0], [0]))
+        c = c + coeffs[j] * contr
+    c = c / N - coeffs[0]
+    reg = jnp.sum(w[1:] * w[1:])
+    return c + lambda_ / (2.0 * N) * reg
+
+
+def closed_form_k1(T1, lambda_, coeffs):
+    """k = 1 minimiser (reference ComputeMinimumWeights,
+    logistic_regression.go:680-691)."""
+    return -coeffs[1] * T1 / lambda_
+
+
+def train(Ts, p: LRParams):
+    """GD on the approximated cost; jitted fori_loop. Returns weights."""
+    dp1 = p.n_features + 1
+    coeffs = tuple(p.coeffs)
+    if p.k == 1:
+        return closed_form_k1(Ts[0], p.lambda_, coeffs)
+
+    w0 = (jnp.asarray(p.initial_weights, dtype=jnp.float64)
+          if len(p.initial_weights) else jnp.zeros((dp1,), jnp.float64))
+    N = float(p.n_records)
+
+    cost_fn = lambda w: cost(w, Ts, N, p.lambda_, coeffs)
+    grad_fn = jax.grad(cost_fn)
+
+    def body(_, state):
+        w, best_w, best_c = state
+        c = cost_fn(w)
+        better = c < best_c
+        best_w = jnp.where(better, w, best_w)
+        best_c = jnp.where(better, c, best_c)
+        w = w - p.step * grad_fn(w)
+        return (w, best_w, best_c)
+
+    w, best_w, best_c = jax.lax.fori_loop(
+        0, p.max_iterations, body, (w0, w0, jnp.float64(jnp.inf)))
+    final_c = cost_fn(w)
+    return jnp.where(final_c < best_c, w, best_w)
+
+
+train_jit = jax.jit(train, static_argnames="p")
+
+
+# ---------------------------------------------------------------------------
+# Prediction + metrics (reference logistic_regression.go:821-899, 1101-1164)
+# ---------------------------------------------------------------------------
+
+def sigmoid(z):
+    return 1.0 / (1.0 + jnp.exp(-z))
+
+
+def predict_probs(X, w, means=None, std_devs=None):
+    Xa = augment(standardise(X, means, std_devs))
+    return sigmoid(Xa @ w)
+
+
+def predict(X, w, means=None, std_devs=None, threshold=0.5):
+    return (predict_probs(X, w, means, std_devs) >= threshold).astype(jnp.int64)
+
+
+def accuracy(pred, actual):
+    pred, actual = np.asarray(pred), np.asarray(actual)
+    return float(np.mean(pred == actual))
+
+
+def precision(pred, actual):
+    pred, actual = np.asarray(pred), np.asarray(actual)
+    tp = int(np.sum((pred == 1) & (actual == 1)))
+    fp = int(np.sum((pred == 1) & (actual == 0)))
+    return tp / (tp + fp) if tp + fp else 0.0
+
+
+def recall(pred, actual):
+    pred, actual = np.asarray(pred), np.asarray(actual)
+    tp = int(np.sum((pred == 1) & (actual == 1)))
+    fn = int(np.sum((pred == 0) & (actual == 1)))
+    return tp / (tp + fn) if tp + fn else 0.0
+
+
+def f_score(pred, actual):
+    pr, rc = precision(pred, actual), recall(pred, actual)
+    return 2 * pr * rc / (pr + rc) if pr + rc else 0.0
+
+
+def auc(probs, actual):
+    """Area under the ROC curve (trapezoidal, like gonum integrate)."""
+    probs, actual = np.asarray(probs, float), np.asarray(actual)
+    order = np.argsort(-probs, kind="stable")
+    lab = actual[order]
+    P, Nn = int(lab.sum()), int((1 - lab).sum())
+    if P == 0 or Nn == 0:
+        return 0.0
+    tpr = np.concatenate([[0.0], np.cumsum(lab) / P])
+    fpr = np.concatenate([[0.0], np.cumsum(1 - lab) / Nn])
+    return float(np.trapezoid(tpr, fpr))
+
+
+# ---------------------------------------------------------------------------
+# Dataset loading + DP sharding (reference logistic_regression.go:1275-1443)
+# ---------------------------------------------------------------------------
+
+def load_csv(path, label_column=0, sep=","):
+    """CSV -> (X float64 (n, d), y int64 (n,))."""
+    raw = np.loadtxt(path, delimiter=sep)
+    y = raw[:, label_column].astype(np.int64)
+    X = np.delete(raw, label_column, axis=1)
+    return X, y
+
+
+def shard_for_dp(X, y, dp_id: int, num_dps: int):
+    """Row-shard i % num_dps == dp_id (reference GetDataForDataProvider,
+    logistic_regression.go:1427-1443)."""
+    idx = np.arange(len(y)) % num_dps == dp_id
+    return X[idx], y[idx]
+
+
+def synthetic_dataset(n=768, d=8, seed=0):
+    """Pima-shaped synthetic binary-classification data (for benches/tests
+    when no CSV is available)."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)) * rng.uniform(0.5, 3.0, size=d) + \
+        rng.uniform(-2, 2, size=d)
+    w_true = rng.normal(size=d + 1)
+    z = w_true[0] + ((X - X.mean(0)) / X.std(0)) @ w_true[1:]
+    y = (1 / (1 + np.exp(-z)) > rng.uniform(size=n)).astype(np.int64)
+    return X, y
+
+
+__all__ = [
+    "TAYLOR_COEFFS", "MIN_AREA_COEFFS", "LRParams",
+    "standardise", "normalize", "augment", "approx_tensors", "encode_clear",
+    "unpack", "cost", "closed_form_k1", "train", "train_jit",
+    "sigmoid", "predict_probs", "predict",
+    "accuracy", "precision", "recall", "f_score", "auc",
+    "load_csv", "shard_for_dp", "synthetic_dataset",
+]

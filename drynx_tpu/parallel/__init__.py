@@ -1,0 +1,17 @@
+"""Distributed collectives for encrypted tensors over a jax.sharding.Mesh.
+
+TPU-first re-design of the reference's onet tree protocols (SURVEY.md §2.3):
+the CN aggregation tree becomes a butterfly all-reduce of EC-point limb
+tensors over an ICI mesh axis; sequential per-server key-switching becomes a
+single all-reduce of commuting per-server contributions; the obfuscation
+protocol's chain of scalar multiplications collapses to one scalar-mult by
+the all-reduced product of server scalars.
+"""
+from .collective import (  # noqa: F401
+    allreduce_group_add,
+    allreduce_scalar_mul,
+    collective_key,
+    keyswitch_contribution,
+    keyswitch_finish,
+    make_mesh,
+)

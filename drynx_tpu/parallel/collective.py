@@ -1,0 +1,145 @@
+"""Encrypted collectives: group-law all-reduce, key-switch, obfuscation.
+
+Replaces the reference's protocol layer (SURVEY.md §2.1 #18-19, #21 and the
+unlynx CollectiveAggregation / KeySwitching protocols used at
+services/service.go:465-616):
+
+* Aggregation: the n-ary CN tree (`GenerateNaryTreeWithRoot(2,...)`,
+  services/service.go:676) becomes `allreduce_group_add` — a log2(n)-step
+  XOR-butterfly of `ppermute` + Jacobian point adds riding ICI.
+
+* Key-switching: the reference walks servers sequentially, each partially
+  decrypting and re-encrypting (unlynx KeySwitchSequence). The per-server
+  contributions commute:
+      K_new = Σ_i r_i·B,   C_new = C + Σ_i (r_i·Q − x_i·K)
+  so one all-reduce of contributions replaces the server chain.
+
+* Obfuscation: each server multiplying every ciphertext by a fresh scalar
+  (protocols/obfuscation_protocol.go:241-243) telescopes to ONE scalar-mult
+  by ∏_i s_i — computed with a log-step all-reduce in Fn (Montgomery mul as
+  the combiner) — preserving exactly the zero/nonzero semantics.
+
+All functions here are designed to run inside `shard_map` over a named mesh
+axis; they are pure and jit-safe.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as C
+from ..crypto import elgamal as eg
+from ..crypto import field as F
+from ..crypto import refimpl
+from ..crypto.field import FN
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "srv"):
+    """1-D device mesh over the server/DP axis."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return jax.sharding.Mesh(np.asarray(devs[:n]), (axis,))
+
+
+# ---------------------------------------------------------------------------
+# All-reduce with custom combiners (butterfly for 2^k, ring otherwise)
+# ---------------------------------------------------------------------------
+
+def _allreduce(x, axis: str, axis_size: int, combine):
+    if axis_size == 1:
+        return x
+    if axis_size & (axis_size - 1) == 0:
+        k = 1
+        while k < axis_size:
+            perm = [(i, i ^ k) for i in range(axis_size)]
+            x = combine(x, jax.lax.ppermute(x, axis, perm))
+            k *= 2
+        return x
+    # ring all-reduce: n-1 shifted adds
+    acc = x
+    cur = x
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for _ in range(axis_size - 1):
+        cur = jax.lax.ppermute(cur, axis, perm)
+        acc = combine(acc, cur)
+    return acc
+
+
+def allreduce_group_add(ct, axis: str, axis_size: int):
+    """All-reduce homomorphic sum of ciphertexts/points over a mesh axis.
+
+    `ct`: any (..., 3, 16) point tensor (ciphertexts (..., 2, 3, 16) work
+    because the group add batches over all leading dims).
+    """
+    return _allreduce(ct, axis, axis_size, C.add)
+
+
+def allreduce_scalar_mul(s_plain, axis: str, axis_size: int):
+    """All-reduce PRODUCT of mod-n scalars (plain limbs in, plain out)."""
+    s_mont = F.to_mont(s_plain, FN)
+    combine = partial(F.mont_mul, ctx=FN)
+    prod = _allreduce(s_mont, axis, axis_size, combine)
+    return F.from_mont(prod, FN)
+
+
+# ---------------------------------------------------------------------------
+# Collective key (host-side setup)
+# ---------------------------------------------------------------------------
+
+def collective_key(pubs):
+    """Sum of server public keys (host affine ints) -> collective pub."""
+    acc = None
+    for p in pubs:
+        acc = refimpl.g1_add(acc, p)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Key-switching (collective re-encryption to the querier's key)
+# ---------------------------------------------------------------------------
+
+def keyswitch_contribution(ct, x_limbs, r_limbs, query_pub_table,
+                           base_table=None):
+    """One server's key-switch contribution for a (batch of) ciphertext(s).
+
+    ct: (..., 2, 3, 16) under the collective key (replicated across servers).
+    x_limbs: this server's secret scalar (16,). r_limbs: fresh randomness,
+    shape ct.shape[:-3] + (16,). Returns (K_contrib, C_contrib) points.
+    """
+    base_table = base_table if base_table is not None else eg.BASE_TABLE.table
+    K = ct[..., 0, :, :]
+    xK = C.scalar_mul(K, x_limbs)
+    rB = eg.fixed_base_mul(base_table, r_limbs)
+    rQ = eg.fixed_base_mul(query_pub_table, r_limbs)
+    return rB, C.add(rQ, C.neg(xK))
+
+
+def keyswitch_finish(ct, k_sum, c_sum):
+    """Assemble the switched ciphertext from all-reduced contributions."""
+    C_new = C.add(ct[..., 1, :, :], c_sum)
+    return jnp.stack([k_sum, C_new], axis=-3)
+
+
+def keyswitch_collective(ct, x_limbs, r_limbs, query_pub_table, axis: str,
+                         axis_size: int):
+    """Full in-mesh key switch: per-server contribution + all-reduce."""
+    kc, cc = keyswitch_contribution(ct, x_limbs, r_limbs, query_pub_table)
+    k_sum = allreduce_group_add(kc, axis, axis_size)
+    c_sum = allreduce_group_add(cc, axis, axis_size)
+    return keyswitch_finish(ct, k_sum, c_sum)
+
+
+def obfuscate_collective(ct, s_limbs, axis: str, axis_size: int):
+    """In-mesh obfuscation: ct * ∏ servers' scalars (zero/nonzero-preserving)."""
+    s_prod = allreduce_scalar_mul(s_limbs, axis, axis_size)
+    return eg.ct_scalar_mul(ct, s_prod)
+
+
+__all__ = [
+    "make_mesh", "allreduce_group_add", "allreduce_scalar_mul",
+    "collective_key", "keyswitch_contribution", "keyswitch_finish",
+    "keyswitch_collective", "obfuscate_collective",
+]

@@ -1,0 +1,99 @@
+"""Differential privacy: quantized Laplace noise + distributed results
+obfuscation (DRO) via re-randomized shuffling.
+
+Reference semantics (SURVEY.md §2.2): the DRO phase builds a list of
+encrypted, quantized Laplace noise values; servers shuffle + re-randomize the
+list so no one knows which noise value lands on which result; one noise
+ciphertext is added per result at the key-switch root
+(reference services/service.go:600-604, 619-665; noise list from unlynx
+GenerateNoiseValuesScale at service.go:657).
+
+The noise list is DETERMINISTIC (privacy comes from the secret shuffle, not
+from sampling): quantized values 0, ±q, ±2q, ... are repeated proportionally
+to the Laplace(mean, b) density until `size` values exist.
+
+TPU-first shuffle: each server applies a secret permutation (device PRNG) and
+re-randomizes every ciphertext by adding a fresh encryption of zero — the
+composition over servers is the reference's Neff-shuffle pipeline's effect.
+The shuffle proof itself lives in drynx_tpu.proofs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import elgamal as eg
+
+
+def generate_noise_values(size: int, mean: float, b: float, quanta: float,
+                          scale: float = 1.0, limit: float = 0.0) -> np.ndarray:
+    """Deterministic quantized-Laplace noise list (int64, scaled).
+
+    Mirrors unlynx GenerateNoiseValuesScale as used at reference
+    services/service.go:657: values v = mean ± k*quanta, each repeated
+    proportionally to exp(-|v-mean|/b); `scale` multiplies values before
+    int64 quantization; `limit` (if nonzero) truncates |v| <= limit.
+    """
+    if size <= 0:
+        return np.zeros((0,), dtype=np.int64)
+    vals: list[float] = []
+    k = 0
+    while len(vals) < size:
+        for v in ([mean] if k == 0 else [mean + k * quanta, mean - k * quanta]):
+            if limit and abs(v) > limit:
+                continue
+            dens = math.exp(-abs(v - mean) / b)
+            rep = max(1, int(round(dens * size * quanta / (2.0 * b))))
+            vals.extend([v] * rep)
+            if len(vals) >= size:
+                break
+        k += 1
+        if k > 10 * size:  # safety for degenerate params
+            break
+    out = np.asarray(vals[:size], dtype=np.float64) * scale
+    return np.round(out).astype(np.int64)
+
+
+def encrypt_noise(key, pub_table: eg.FixedBase, noise: np.ndarray):
+    """Encrypt the noise list under the collective key."""
+    ct, _ = eg.encrypt_ints(key, pub_table, jnp.asarray(noise))
+    return ct
+
+
+def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None):
+    """One server's DRO step: secret permutation + re-randomization.
+
+    cts: (S, 2, 3, 16). Returns (shuffled cts, permutation, rerand scalars)
+    — the latter two feed the shuffle proof.
+    """
+    base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
+    S = cts.shape[0]
+    kperm, krand = jax.random.split(key)
+    perm = jax.random.permutation(kperm, S)
+    shuffled = jnp.take(cts, perm, axis=0)
+    r = eg.random_scalars(krand, (S,))
+    zeros = jnp.zeros((S,), dtype=jnp.int64)
+    zero_ct = eg.encrypt_with_tables(base_tbl, pub_tbl,
+                                     eg.int_to_scalar(zeros), r)
+    return eg.ct_add(shuffled, zero_ct), perm, r
+
+
+def dro_pipeline(key, pub_tbl, size: int, mean: float, b: float,
+                 quanta: float, scale: float = 1.0, limit: float = 0.0,
+                 n_servers: int = 3):
+    """Full noise phase: generate, encrypt, pass through every server's
+    shuffle+rerandomize. Returns the final encrypted noise list."""
+    noise = generate_noise_values(size, mean, b, quanta, scale, limit)
+    key, sub = jax.random.split(key)
+    cts = encrypt_noise(sub, pub_tbl, noise)
+    for _ in range(n_servers):
+        key, sub = jax.random.split(key)
+        cts, _, _ = shuffle_rerandomize(sub, cts, pub_tbl.table)
+    return cts, noise
+
+
+__all__ = ["generate_noise_values", "encrypt_noise", "shuffle_rerandomize",
+           "dro_pipeline"]

@@ -1,0 +1,12 @@
+"""Host-side orchestration: query model, node roles (CN/DP/VN), proof
+pipeline, audit chain — the reference's services/ layer re-built around the
+TPU data plane (SURVEY.md §7 stage 6)."""
+from .query import (  # noqa: F401
+    DiffPParams,
+    Operation,
+    Query,
+    SurveyQuery,
+    check_parameters,
+    choose_operation,
+    query_to_proofs_nbrs,
+)

@@ -1,12 +1,26 @@
-"""Test harness config: force a virtual 8-device CPU mesh before JAX imports.
+"""Test harness config: force a virtual 8-device CPU mesh before JAX use.
 
 Mirrors the reference's in-process multi-node test strategy (onet LocalTest,
 reference: services/service_test.go:29-66) — multi-"node" here means multiple
 XLA host devices so sharding/collective paths run for real without TPUs.
+
+The environment may pin JAX_PLATFORMS to a hardware plugin (e.g. a tunneled
+TPU) via sitecustomize, so a plain env override is not enough: we also update
+jax.config before any backend is instantiated.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the crypto kernels (256-step scalar-mult
+# scans, Miller loops) are compile-heavy; cache them across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/drynx_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,114 @@
+"""Encoder round-trip tests: N DPs encode+encrypt, homomorphic aggregate,
+decrypt, decode == clear-text computation.
+
+Mirrors the reference's encoder unit-test pattern (keypair -> encode ->
+decode -> assert vs clear text, e.g. lib/encoding/sum_test.go:15-57,
+min_max.go / OR_AND.go tests).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.encoding import DecryptedVector, decode, encode_clear, output_size
+
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture(scope="module")
+def keys():
+    x, pub = eg.keygen(RNG)
+    return x, eg.pub_table(pub), eg.DecryptionTable(limit=4000)
+
+
+def run_survey(op, dp_datas, keys, qmin=0, qmax=0, dims=1, preds=None,
+               scales=None):
+    """Encrypted pipeline for one op over several DPs."""
+    x, ptab, table = keys
+    agg = None
+    key = jax.random.PRNGKey(123)
+    for i, data in enumerate(dp_datas):
+        stats = encode_clear(
+            op, data, qmin, qmax,
+            preds=None if preds is None else preds[i],
+            bit_scale=None if scales is None else scales[i])
+        key, sub = jax.random.split(key)
+        ct, _ = eg.encrypt_ints(sub, ptab, stats)
+        agg = ct if agg is None else eg.ct_add(agg, ct)
+    vals, found = eg.decrypt_ints(agg, x, table)
+    iszero = eg.decrypt_check_zero(agg, jnp.asarray(eg.secret_to_limbs(x)))
+    dec = DecryptedVector(np.asarray(vals), np.asarray(found),
+                          np.asarray(iszero))
+    assert output_size(op, qmin, qmax, dims) == len(np.asarray(vals))
+    return decode(op, dec, qmin, qmax, dims)
+
+
+def test_sum_mean_variance(keys):
+    dps = [RNG.integers(0, 10, size=12) for _ in range(3)]
+    allv = np.concatenate(dps)
+    assert run_survey("sum", dps, keys) == int(allv.sum())
+    assert run_survey("mean", dps, keys) == pytest.approx(allv.mean())
+    assert run_survey("variance", dps, keys) == pytest.approx(allv.var())
+
+
+def test_cosim(keys):
+    dps = [RNG.integers(1, 10, size=(8, 2)) for _ in range(2)]
+    allv = np.concatenate(dps)
+    a, b = allv[:, 0].astype(float), allv[:, 1].astype(float)
+    want = (a * b).sum() / (np.sqrt((a * a).sum()) * np.sqrt((b * b).sum()))
+    assert run_survey("cosim", dps, keys) == pytest.approx(want)
+
+
+def test_bool_or_and(keys):
+    assert run_survey("bool_OR", [[0, 0], [0, 1], [0]], keys) is True
+    assert run_survey("bool_OR", [[0, 0], [0]], keys) is False
+    assert run_survey("bool_AND", [[1, 2], [3]], keys) is True
+    assert run_survey("bool_AND", [[1, 0], [3]], keys) is False
+    # randomized bit scales (non-proof mode) must preserve the answer
+    scales = [int(RNG.integers(1, 2**20)) for _ in range(3)]
+    assert run_survey("bool_OR", [[0], [1], [0]], keys, scales=scales) is True
+    assert run_survey("bool_AND", [[1], [1], [1]], keys, scales=scales) is True
+
+
+def test_min_max(keys):
+    dps = [[5, 9], [3, 8], [7]]
+    assert run_survey("min", dps, keys, qmin=0, qmax=15) == 3
+    assert run_survey("max", dps, keys, qmin=0, qmax=15) == 9
+    scales = [int(RNG.integers(1, 2**20)) for _ in range(3)]
+    assert run_survey("min", dps, keys, 0, 15, scales=scales) == 3
+    assert run_survey("max", dps, keys, 0, 15, scales=scales) == 9
+
+
+def test_frequency_count(keys):
+    dps = [[1, 2, 2], [2, 4]]
+    got = run_survey("frequency_count", dps, keys, qmin=0, qmax=5)
+    assert got == {0: 0, 1: 1, 2: 3, 3: 0, 4: 1, 5: 0}
+
+
+def test_union_inter(keys):
+    dps = [[1, 3], [3, 5]]
+    assert run_survey("union", dps, keys, 0, 6) == [1, 3, 5]
+    assert run_survey("inter", dps, keys, 0, 6) == [3]
+    scales = [int(RNG.integers(1, 2**20)) for _ in range(2)]
+    assert run_survey("inter", dps, keys, 0, 6, scales=scales) == [3]
+
+
+def test_lin_reg(keys):
+    # y = 2 + 3*x1 - x2 exactly; solved weights must match exactly.
+    X = RNG.integers(0, 8, size=(20, 2))
+    y = 2 + 3 * X[:, 0] - X[:, 1]
+    rows = np.concatenate([X, y[:, None]], axis=1)
+    dps = [rows[:10], rows[10:]]
+    w = run_survey("lin_reg", dps, keys, dims=2)
+    assert np.allclose(w, [2.0, 3.0, -1.0])
+
+
+def test_r2(keys):
+    y = [np.asarray([3, 5, 7]), np.asarray([4, 6])]
+    preds = [np.asarray([3, 4, 7]), np.asarray([5, 6])]
+    got = run_survey("r2", y, keys, preds=preds)
+    ally = np.concatenate(y).astype(float)
+    allp = np.concatenate(preds).astype(float)
+    want = 1 - ((allp - ally) ** 2).sum() / ((ally - ally.mean()) ** 2).sum()
+    assert got == pytest.approx(want)

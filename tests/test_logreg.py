@@ -1,0 +1,127 @@
+"""Logistic-regression tests: einsum tensors vs naive per-record oracle, and
+the full encrypted training slice (encode -> encrypt -> aggregate ->
+key-switch -> decrypt -> GD) vs clear-text training.
+
+Mirrors the reference's exhaustive LR testing strategy
+(lib/encoding/logistic_regression_test.go:20-773 — encrypted path must agree
+with the clear-text twin; accuracy asserted on real-shaped data).
+"""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.models import logreg as lr
+
+RNG = np.random.default_rng(31)
+
+
+def naive_tensors(Xa, y, k):
+    """Per-record loop oracle for the approx tensors (ordered tuples)."""
+    n, dp1 = Xa.shape
+    out = []
+    for j in range(1, k + 1):
+        T = np.zeros((dp1,) * j)
+        for i in range(n):
+            s = (2 * y[i] - 1) if j % 2 == 1 else -1
+            for tup in itertools.product(range(dp1), repeat=j):
+                prod = 1.0
+                for t in tup:
+                    prod *= Xa[i, t]
+                T[tup] += s * prod
+        out.append(T.reshape(-1))
+    return out
+
+
+def test_approx_tensors_match_naive():
+    X = RNG.normal(size=(7, 3))
+    y = RNG.integers(0, 2, size=7)
+    Xa = np.asarray(lr.augment(X))
+    for k in (1, 2, 3):
+        got = lr.approx_tensors(Xa, y, k)
+        want = naive_tensors(Xa, y, k)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), w, rtol=1e-10)
+
+
+def test_train_matches_reference_style_gd():
+    """GD on approx cost reaches decent accuracy on separable-ish data."""
+    X, y = lr.synthetic_dataset(n=400, d=4, seed=5)
+    p = lr.LRParams(k=2, precision=1.0, lambda_=1.0, step=0.1,
+                    max_iterations=200, n_features=4, n_records=400,
+                    means=tuple(np.mean(X, 0)), std_devs=tuple(np.std(X, 0)))
+    stats = lr.encode_clear(X, y, p)
+    Ts = lr.unpack(np.asarray(stats), p)
+    w = lr.train(Ts, p)
+    pred = lr.predict(X, w, p.means, p.std_devs)
+    acc = lr.accuracy(pred, y)
+    assert acc > 0.75, acc
+
+
+def test_closed_form_k1():
+    X, y = lr.synthetic_dataset(n=200, d=3, seed=9)
+    p = lr.LRParams(k=1, precision=1.0, lambda_=1.0, n_features=3,
+                    n_records=200)
+    stats = lr.encode_clear(X, y, p)
+    Ts = lr.unpack(np.asarray(stats), p)
+    w = lr.train(Ts, p)
+    assert np.all(np.isfinite(np.asarray(w)))
+
+
+def test_encrypted_training_end_to_end():
+    """THE minimum end-to-end slice (SURVEY.md §7 stage 3): 10 DPs encrypt
+    local LR stats, homomorphic aggregation, decrypt, GD — decrypted ints
+    must EQUAL the clear sums, and accuracy must match the clear pipeline."""
+    num_dps = 10
+    X, y = lr.synthetic_dataset(n=300, d=3, seed=7)
+    means = tuple(np.mean(X, 0))
+    stds = tuple(np.std(X, 0))
+    p = lr.LRParams(k=2, precision=1.0, lambda_=1.0, step=0.1,
+                    max_iterations=150, n_features=3, n_records=300,
+                    means=means, std_devs=stds)
+
+    x_sec, pub = eg.keygen(RNG)
+    ptab = eg.pub_table(pub)
+    table = eg.DecryptionTable(limit=2000)
+
+    clear_sum = np.zeros(p.num_coeffs(), dtype=np.int64)
+    agg = None
+    key = jax.random.PRNGKey(77)
+    for dp in range(num_dps):
+        Xd, yd = lr.shard_for_dp(X, y, dp, num_dps)
+        stats = np.asarray(lr.encode_clear(Xd, yd, p))
+        clear_sum += stats
+        key, sub = jax.random.split(key)
+        ct, _ = eg.encrypt_ints(sub, ptab, stats)
+        agg = ct if agg is None else eg.ct_add(agg, ct)
+
+    dec, found = eg.decrypt_ints(agg, x_sec, table)
+    assert bool(np.all(np.asarray(found)))
+    np.testing.assert_array_equal(np.asarray(dec), clear_sum)
+
+    w_enc = lr.train(lr.unpack(np.asarray(dec), p), p)
+    w_clear = lr.train(lr.unpack(clear_sum, p), p)
+    np.testing.assert_allclose(np.asarray(w_enc), np.asarray(w_clear))
+
+    acc = lr.accuracy(lr.predict(X, w_enc, means, stds), y)
+    assert acc > 0.75, acc
+
+
+def test_metrics():
+    pred = np.asarray([1, 0, 1, 1, 0])
+    act = np.asarray([1, 0, 0, 1, 1])
+    assert lr.accuracy(pred, act) == pytest.approx(0.6)
+    assert lr.precision(pred, act) == pytest.approx(2 / 3)
+    assert lr.recall(pred, act) == pytest.approx(2 / 3)
+    assert lr.f_score(pred, act) == pytest.approx(2 / 3)
+    probs = np.asarray([0.9, 0.1, 0.8, 0.7, 0.3])
+    assert 0.5 <= lr.auc(probs, act) <= 1.0
+
+
+def test_auc_perfect_classifier():
+    probs = np.asarray([0.9, 0.8, 0.2, 0.1])
+    act = np.asarray([1, 1, 0, 0])
+    assert lr.auc(probs, act) == pytest.approx(1.0)

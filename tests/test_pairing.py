@@ -1,0 +1,98 @@
+"""Device Fp2/Fp12/G2/pairing kernels vs the pure-Python oracle."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from drynx_tpu.crypto import fp2 as F2
+from drynx_tpu.crypto import fp12 as F12
+from drynx_tpu.crypto import g2 as G2
+from drynx_tpu.crypto import pairing as PAIR
+from drynx_tpu.crypto import curve as C
+from drynx_tpu.crypto import field as F
+from drynx_tpu.crypto import params, refimpl
+
+RNG = np.random.default_rng(41)
+
+
+def rand_fp():
+    return int.from_bytes(RNG.bytes(40), "little") % params.P
+
+
+def rand_fp2():
+    return (rand_fp(), rand_fp())
+
+
+def rand_fp12():
+    return tuple(rand_fp2() for _ in range(6))
+
+
+def test_fp2_ops_match_oracle():
+    a, b = rand_fp2(), rand_fp2()
+    da, db = jnp.asarray(F2.from_ref(a)), jnp.asarray(F2.from_ref(b))
+    assert F2.to_ref(F2.add(da, db)) == refimpl.fp2_add(a, b)
+    assert F2.to_ref(F2.sub(da, db)) == refimpl.fp2_sub(a, b)
+    assert F2.to_ref(F2.mul(da, db)) == refimpl.fp2_mul(a, b)
+    assert F2.to_ref(F2.sqr(da)) == refimpl.fp2_sq(a)
+    assert F2.to_ref(F2.inv(da)) == refimpl.fp2_inv(a)
+    assert F2.to_ref(F2.mul_xi(da)) == refimpl.fp2_mul(a, params.XI)
+
+
+def test_fp12_mul_inv_match_oracle():
+    a, b = rand_fp12(), rand_fp12()
+    da, db = jnp.asarray(F12.from_ref(a)), jnp.asarray(F12.from_ref(b))
+    assert F12.to_ref(F12.mul(da, db)) == refimpl.fp12_mul(a, b)
+    assert F12.to_ref(F12.conj6(da)) == refimpl.fp12_conj6(a)
+    got_inv = F12.to_ref(F12.inv(da))
+    assert refimpl.fp12_mul(got_inv, a) == refimpl.FP12_ONE
+
+
+def test_fp12_pow_matches_oracle():
+    a = rand_fp12()
+    da = jnp.asarray(F12.from_ref(a))
+    e = 0xDEADBEEFCAFE
+    assert F12.to_ref(F12.pow_const(da, e)) == refimpl.fp12_pow(a, e)
+
+
+def test_g2_group_law_matches_oracle():
+    k1, k2 = 12345, 987654321
+    P1 = refimpl.g2_mul(refimpl.G2, k1)
+    P2 = refimpl.g2_mul(refimpl.G2, k2)
+    d1, d2 = jnp.asarray(G2.from_ref(P1)), jnp.asarray(G2.from_ref(P2))
+    assert G2.to_ref(G2.add(d1, d2)) == refimpl.g2_add(P1, P2)
+    assert G2.to_ref(G2.double(d1)) == refimpl.g2_add(P1, P1)
+    # doubling path through add
+    assert G2.to_ref(G2.add(d1, d1)) == refimpl.g2_add(P1, P1)
+    # inverse points -> infinity
+    assert G2.to_ref(G2.add(d1, G2.neg(d1))) is None
+
+
+def test_g2_scalar_mul_matches_oracle():
+    k = int.from_bytes(RNG.bytes(31), "little")
+    dG = jnp.asarray(G2.G2_GEN)
+    got = G2.to_ref(G2.scalar_mul(dG, jnp.asarray(F.from_int(k % params.N))))
+    assert got == refimpl.g2_mul(refimpl.G2, k)
+
+
+def _pair_dev(p1, q2):
+    """Host points -> device pairing -> oracle representation."""
+    xp_m = jnp.asarray(F.from_int(p1[0] * params.R % params.P))
+    yp_m = jnp.asarray(F.from_int(p1[1] * params.R % params.P))
+    xq = jnp.asarray(F2.from_ref(q2[0]))
+    yq = jnp.asarray(F2.from_ref(q2[1]))
+    return F12.to_ref(PAIR.pair((xp_m, yp_m), (xq, yq)))
+
+
+def test_pairing_matches_oracle():
+    got = _pair_dev(refimpl.G1, refimpl.G2)
+    want = refimpl.pair(refimpl.G1, refimpl.G2)
+    assert got == want
+
+
+def test_pairing_bilinear_on_device():
+    a, b = 7, 13
+    Pa = refimpl.g1_mul(refimpl.G1, a)
+    Qb = refimpl.g2_mul(refimpl.G2, b)
+    lhs = _pair_dev(Pa, Qb)
+    base = refimpl.pair(refimpl.G1, refimpl.G2)
+    rhs = refimpl.fp12_pow(base, a * b)
+    assert lhs == rhs
