@@ -7,6 +7,21 @@ packing (the crypto path itself is pure uint32 limb math); float kernels in
 the training path explicitly request float32/bfloat16, so enabling x64 here
 does not put float64 on the TPU hot path.
 """
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: OPT-IN via DRYNX_JAX_CACHE=<dir>.
+# Disabled by default because jaxlib has been observed to segfault when
+# deserializing the very large crypto-kernel executables back out of the
+# cache (crash in compilation_cache.get_executable_and_time). The framework
+# instead keeps compiles rare by design: rolled limb loops (small graphs,
+# crypto/field.py) and per-bucket jits reused in-process (crypto/batching.py).
+_cache = os.environ.get("DRYNX_JAX_CACHE", "")
+if _cache and _cache != "off" and not jax.config.jax_compilation_cache_dir:
+    os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,153 @@
+"""Batch-shape canonicalization: compile heavy kernels once per size bucket.
+
+Every proof kernel batches over some (ns, V, l, ...) shape that varies per
+query; jitting a monolithic kernel per configuration would recompile the
+256-step crypto scans for every new shape. Instead the proof layer calls
+these wrappers, which flatten all leading batch dims into one axis, pad it
+up to a power-of-two bucket (edge-padding with real values, so no degenerate
+inputs), invoke the jitted kernel on the canonical shape, and slice the
+result back. Each kernel therefore compiles O(log max_batch) times total,
+across all call sites and queries.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _next_bucket(b: int, min_bucket: int = 8) -> int:
+    p = min_bucket
+    while p < b:
+        p *= 2
+    return p
+
+
+def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8):
+    """Wrap fn so all leading batch dims are flattened + bucket-padded.
+
+    The wrapped fn is jitted as ONE executable per bucket size, so repeated
+    calls (any batch shape) reuse the in-process jit cache. min_bucket sets
+    the smallest bucket — raise it for compile-heavy kernels (pairings) so a
+    single compile serves every small batch.
+
+    NOTE: the on-disk persistent compilation cache is deliberately NOT used
+    for these kernels — jaxlib has been observed to segfault deserializing
+    the very large serialized executables (crash in
+    compilation_cache.get_executable_and_time); see conftest/__init__.
+
+    tail_ranks: pytree matching fn's positional args, each leaf an int = the
+    rank of that argument's per-element (non-batch) suffix, or -1 to pass the
+    argument through untouched (constant tables etc., not batched).
+    out_tail_ranks: pytree matching fn's output, same meaning.
+    """
+    fn = jax.jit(fn)
+
+    def wrapped(*args):
+        leaves, treedef = jax.tree.flatten(tuple(args),
+                                           is_leaf=lambda x: x is None)
+        ranks = jax.tree.flatten(tail_ranks)[0]
+        assert len(leaves) == len(ranks), (len(leaves), len(ranks))
+        leaves = [jnp.asarray(l) for l in leaves]
+        batch = jnp.broadcast_shapes(
+            *[l.shape[: l.ndim - r] for l, r in zip(leaves, ranks)
+              if r >= 0])
+        B = int(np.prod(batch)) if batch else 1
+        Bp = _next_bucket(B, min_bucket)
+
+        flat = []
+        for l, r in zip(leaves, ranks):
+            if r < 0:
+                flat.append(l)
+                continue
+            tail = l.shape[l.ndim - r:] if r else ()
+            lb = jnp.broadcast_to(l, batch + tail).reshape((B,) + tail)
+            if Bp != B:
+                pad = jnp.broadcast_to(lb[:1], (Bp - B,) + tail)
+                lb = jnp.concatenate([lb, pad], axis=0)
+            flat.append(lb)
+        out = fn(*treedef.unflatten(flat))
+
+        out_leaves, out_def = jax.tree.flatten(out)
+        out_ranks = jax.tree.flatten(out_tail_ranks)[0]
+        res = []
+        for o, r in zip(out_leaves, out_ranks):
+            o = o[:B]
+            tail = o.shape[1:]
+            res.append(o.reshape(batch + tail))
+        return out_def.unflatten(res)
+
+    return wrapped
+
+
+def tree_reduce_add(tensor, add_fn, axis: int = 0):
+    """Log-depth reduction of `tensor` along `axis` with a batched group-add.
+
+    The on-chip analogue of the reference's n-ary CN aggregation tree
+    (services/service.go:676); works for points and ciphertexts alike.
+    """
+    t = jnp.moveaxis(jnp.asarray(tensor), axis, 0)
+    n = int(t.shape[0])
+    while n > 1:
+        half = n // 2
+        red = add_fn(t[: 2 * half : 2], t[1 : 2 * half : 2])
+        t = jnp.concatenate([red, t[-1:]], axis=0) if n % 2 else red
+        n = int(t.shape[0])
+    return t[0]
+
+
+# ---------------------------------------------------------------------------
+# Bucketed views of the hot kernels (imported lazily to avoid cycles)
+# ---------------------------------------------------------------------------
+
+def _build():
+    from . import curve as C
+    from . import g2 as G2
+    from . import fp12 as F12
+    from . import pairing as PAIR
+    from . import elgamal as eg
+    from . import field as F
+    from .field import FN
+
+    g = globals()
+    g["g1_add"] = bucketed(C.add, (2, 2), 2)
+    g["g1_neg"] = bucketed(C.neg, (2,), 2)
+    g["g1_scalar_mul"] = bucketed(C.scalar_mul, (2, 1), 2)
+    g["g1_eq"] = bucketed(C.eq, (2, 2), 0)
+    g["g1_normalize"] = bucketed(C.normalize, (2,), (1, 1, 0))
+    g["g2_scalar_mul"] = bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32)
+    g["g2_normalize"] = bucketed(G2.normalize, (3,), (2, 2, 0),
+                                 min_bucket=32)
+    g["fixed_base_mul"] = bucketed(eg.fixed_base_mul, (-1, 1), 2)
+    g["pair"] = bucketed(
+        lambda px, py, qx, qy: PAIR.pair((px, py), (qx, qy)),
+        (1, 1, 2, 2), 3, min_bucket=32)
+    g["gt_pow"] = bucketed(F12.pow_var, (3, 1), 3, min_bucket=32)
+    g["gt_mul"] = bucketed(F12.mul, (3, 3), 3, min_bucket=32)
+    g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32)
+    g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1)
+    g["fn_sub"] = bucketed(lambda a, b: F.sub(a, b, FN), (1, 1), 1)
+    g["fn_neg"] = bucketed(lambda a: F.neg(a, FN), (1,), 1)
+    g["fn_mul_plain"] = bucketed(
+        lambda a, b: F.mont_mul(F.to_mont(a, FN), b, FN), (1, 1), 1)
+    g["fn_mont_mul"] = bucketed(lambda a, b: F.mont_mul(a, b, FN), (1, 1), 1)
+    # ElGamal layer (ciphertext tail = (2, 3, 16))
+    g["encrypt"] = bucketed(eg.encrypt_with_tables, (-1, -1, 1, 1), 3)
+    g["int_to_scalar"] = bucketed(eg.int_to_scalar, (0,), 1)
+    g["table_lookup"] = bucketed(eg._table_lookup, (-1, -1, -1, -1, 2),
+                                 (0, 0))
+    g["ct_add"] = bucketed(eg.ct_add, (3, 3), 3)
+    g["ct_scalar_mul"] = bucketed(eg.ct_scalar_mul, (3, 1), 3)
+    g["decrypt_point"] = bucketed(eg.decrypt_point, (3, 1), 2)
+    g["is_infinity"] = bucketed(C.is_infinity, (2,), 0)
+
+
+_build()
+
+__all__ = ["bucketed", "tree_reduce_add", "g1_add", "g1_neg",
+           "g1_scalar_mul", "g1_eq",
+           "g1_normalize", "g2_scalar_mul", "g2_normalize", "fixed_base_mul",
+           "pair", "gt_pow", "gt_mul", "gt_eq", "fn_add", "fn_sub", "fn_neg",
+           "fn_mul_plain", "fn_mont_mul", "encrypt", "int_to_scalar",
+           "table_lookup", "ct_add", "ct_scalar_mul", "decrypt_point",
+           "is_infinity"]

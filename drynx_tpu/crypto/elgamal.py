@@ -44,11 +44,11 @@ WINDOW_SIZE = 1 << WINDOW_BITS    # 16
 # ---------------------------------------------------------------------------
 
 def keygen(rng: np.random.Generator):
-    """Return (secret int mod n, public point as host affine ints)."""
-    x = int(rng.integers(1, 1 << 62)) | (int(rng.integers(0, 1 << 62)) << 62)
-    x = (x | (int(rng.integers(0, 1 << 62)) << 124)) % params.N
-    if x == 0:
-        x = 1
+    """Return (secret int mod n, public point as host affine ints).
+
+    Secrets are uniform mod n (512 random bits reduced, bias 2^-256) —
+    structured/short secrets would be kangaroo-attackable."""
+    x = int.from_bytes(rng.bytes(64), "little") % (params.N - 1) + 1
     return x, refimpl.g1_mul(refimpl.G1, x)
 
 
@@ -132,7 +132,9 @@ _N_LIMBS_DEV = None
 def _n_limbs():
     global _N_LIMBS_DEV
     if _N_LIMBS_DEV is None:
-        _N_LIMBS_DEV = jnp.asarray(params.to_limbs(params.N), dtype=jnp.uint32)
+        # numpy (not jnp): caching a device array created during a trace
+        # would leak a tracer into the cache
+        _N_LIMBS_DEV = np.asarray(params.to_limbs(params.N), dtype=np.uint32)
     return _N_LIMBS_DEV
 
 

@@ -91,30 +91,63 @@ def to_int(limbs) -> "int | np.ndarray":
 # Core limb ops (all jit-safe, batch over leading dims)
 # ---------------------------------------------------------------------------
 
+# When set, limb loops are fully unrolled at trace time (bigger XLA graphs,
+# slow compiles, fastest TPU execution). Default: rolled lax.scan loops —
+# ~16x smaller graphs, which keeps CPU-test compile times sane.
+import os
+
+UNROLL = os.environ.get("DRYNX_FIELD_UNROLL", "0") == "1"
+
+
 def _carry_chain(cols, out_limbs):
     """Sequential carry propagation down a column array -> out_limbs limbs.
 
     cols: (..., K) uint32 with values < 2^31. Returns ((..., out_limbs), carry).
     """
-    outs = []
-    carry = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
-    for k in range(out_limbs):
-        v = cols[..., k] + carry
-        outs.append(v & MASK)
-        carry = v >> LIMB_BITS
-    return jnp.stack(outs, axis=-1), carry
+    carry0 = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
+    if UNROLL:
+        outs = []
+        carry = carry0
+        for k in range(out_limbs):
+            v = cols[..., k] + carry
+            outs.append(v & MASK)
+            carry = v >> LIMB_BITS
+        return jnp.stack(outs, axis=-1), carry
+
+    xs = jnp.moveaxis(cols[..., :out_limbs], -1, 0)
+
+    def body(carry, c):
+        v = c + carry
+        return v >> LIMB_BITS, v & MASK
+
+    carry, outs = jax.lax.scan(body, carry0, xs)
+    return jnp.moveaxis(outs, 0, -1), carry
 
 
 def _sub_limbs(a, b):
     """a - b with borrow chain. Returns (diff_limbs, borrow in {0,1})."""
-    outs = []
-    borrow = jnp.zeros(a.shape[:-1] if a.ndim > 1 else (), dtype=jnp.uint32)
-    borrow = jnp.broadcast_to(borrow, jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]))
-    for k in range(NUM_LIMBS):
-        v = a[..., k] - b[..., k] - borrow  # uint32 wraparound is fine
-        outs.append(v & MASK)
-        borrow = (v >> LIMB_BITS) & jnp.uint32(1)  # 1 iff wrapped
-    return jnp.stack(outs, axis=-1), borrow
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (NUM_LIMBS,))
+    b = jnp.broadcast_to(b, batch + (NUM_LIMBS,))
+    borrow0 = jnp.zeros(batch, dtype=jnp.uint32)
+    if UNROLL:
+        outs = []
+        borrow = borrow0
+        for k in range(NUM_LIMBS):
+            v = a[..., k] - b[..., k] - borrow  # uint32 wraparound is fine
+            outs.append(v & MASK)
+            borrow = (v >> LIMB_BITS) & jnp.uint32(1)  # 1 iff wrapped
+        return jnp.stack(outs, axis=-1), borrow
+
+    xs = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
+
+    def body(borrow, ab):
+        av, bv = ab
+        v = av - bv - borrow
+        return (v >> LIMB_BITS) & jnp.uint32(1), v & MASK
+
+    borrow, outs = jax.lax.scan(body, borrow0, xs)
+    return jnp.moveaxis(outs, 0, -1), borrow
 
 
 def _cond_sub_m(a, ctx: ModCtx):
@@ -176,24 +209,60 @@ def mont_mul(a, b, ctx: ModCtx = FP):
     hi = prod >> LIMB_BITS
 
     cols = jnp.zeros(batch + (2 * NUM_LIMBS + 1,), dtype=jnp.uint32)
-    for i in range(NUM_LIMBS):
-        cols = cols.at[..., i:i + NUM_LIMBS].add(lo[..., i, :])
-        cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(hi[..., i, :])
-    # col magnitude < 32 * 0xffff < 2^21
-
     m_limbs = ctx.m_limbs
     nprime = jnp.uint32(ctx.nprime)
-    carry = jnp.zeros(batch, dtype=jnp.uint32)
-    for i in range(NUM_LIMBS):
-        v = cols[..., i] + carry
-        mfac = ((v & MASK) * nprime) & MASK
-        mp = mfac[..., None] * m_limbs  # (...,16) < 2^32
-        mlo = mp & MASK
-        mhi = mp >> LIMB_BITS
-        carry = (v + mlo[..., 0]) >> LIMB_BITS
-        cols = cols.at[..., i + 1:i + NUM_LIMBS].add(mlo[..., 1:])
-        cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(mhi)
-        # per step adds < 2*0xffff + small carry; total stays < 2^22
+
+    if UNROLL:
+        for i in range(NUM_LIMBS):
+            cols = cols.at[..., i:i + NUM_LIMBS].add(lo[..., i, :])
+            cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(hi[..., i, :])
+        # col magnitude < 32 * 0xffff < 2^21
+        carry = jnp.zeros(batch, dtype=jnp.uint32)
+        for i in range(NUM_LIMBS):
+            v = cols[..., i] + carry
+            mfac = ((v & MASK) * nprime) & MASK
+            mp = mfac[..., None] * m_limbs  # (...,16) < 2^32
+            mlo = mp & MASK
+            mhi = mp >> LIMB_BITS
+            carry = (v + mlo[..., 0]) >> LIMB_BITS
+            cols = cols.at[..., i + 1:i + NUM_LIMBS].add(mlo[..., 1:])
+            cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(mhi)
+            # per step adds < 2*0xffff + small carry; total stays < 2^22
+    else:
+        # rolled variants: same arithmetic, scanned over the 16 limb steps
+        # (dynamic slices of STATIC width keep the graph small)
+        zcol = jnp.zeros(batch + (1,), dtype=jnp.uint32)
+        add17 = (jnp.concatenate([lo, jnp.zeros_like(lo[..., :1])], axis=-1)
+                 + jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi], axis=-1))
+        add17_t = jnp.moveaxis(add17, -2, 0)  # (16, ..., 17)
+
+        def sbody(cs, xs_i):
+            i, addend = xs_i
+            seg = jax.lax.dynamic_slice_in_dim(cs, i, NUM_LIMBS + 1, axis=-1)
+            return jax.lax.dynamic_update_slice_in_dim(
+                cs, seg + addend, i, axis=-1), None
+
+        idx = jnp.arange(NUM_LIMBS, dtype=jnp.int32)
+        cols, _ = jax.lax.scan(sbody, cols, (idx, add17_t))
+
+        def rbody(state, i):
+            cs, carry = state
+            v = jax.lax.dynamic_index_in_dim(cs, i, axis=-1,
+                                             keepdims=False) + carry
+            mfac = ((v & MASK) * nprime) & MASK
+            mp = mfac[..., None] * m_limbs
+            mlo = mp & MASK
+            mhi = mp >> LIMB_BITS
+            carry = (v + mlo[..., 0]) >> LIMB_BITS
+            addend = (jnp.concatenate([mlo[..., 1:], jnp.zeros_like(zcol)],
+                                      axis=-1) + mhi)
+            seg = jax.lax.dynamic_slice_in_dim(cs, i + 1, NUM_LIMBS, axis=-1)
+            cs = jax.lax.dynamic_update_slice_in_dim(cs, seg + addend, i + 1,
+                                                     axis=-1)
+            return (cs, carry), None
+
+        carry0 = jnp.zeros(batch, dtype=jnp.uint32)
+        (cols, carry), _ = jax.lax.scan(rbody, (cols, carry0), idx)
 
     # Result = cols[16..32] + reduction carry folded into column 16; value is
     # < 2m (standard Montgomery bound), so one conditional subtract suffices.

@@ -125,5 +125,35 @@ def pow_const(f, e: int):
     return acc
 
 
+@jax.jit
+def pow_var(f, k_limbs):
+    """f^k for a VARIABLE mod-n exponent given as plain limbs (..., 16).
+
+    256-step square-and-multiply-always scan; batches over leading dims of
+    both f (..., 6, 2, 16) and k. The range-proof layer uses this to turn
+    e(t·B, B2) into gtB^t with one precomputed pairing (reference computes
+    the full pairing per element, lib/range/range_proof.go:398-404).
+    """
+    from .params import LIMB_BITS
+    bits = (k_limbs[..., :, None]
+            >> jnp.arange(LIMB_BITS, dtype=jnp.uint32)) & 1
+    bits = bits.reshape(bits.shape[:-2] + (256,))
+    bits_t = jnp.moveaxis(bits, -1, 0)
+
+    batch = jnp.broadcast_shapes(f.shape[:-3], k_limbs.shape[:-1])
+    acc0 = one(batch)
+    base0 = jnp.broadcast_to(f, batch + f.shape[-3:])
+
+    def step(state, bit):
+        acc, base = state
+        acc2 = mul(acc, base)
+        acc = jnp.where(bit[..., None, None, None] == 1, acc2, acc)
+        base = sqr(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (acc0, base0), bits_t)
+    return acc
+
+
 __all__ = ["from_ref", "to_ref", "one", "mul", "sqr", "conj6", "eq", "inv",
-           "pow_const"]
+           "pow_const", "pow_var"]

@@ -48,7 +48,8 @@ def _sparse_mul(f, l0, l2, l3):
     for k in range(6):
         out[k] = acc[k]
     for k in range(6, 11):
-        out[k - 6] = F2.add(out[k - 6], F2.mul_xi(acc[k]))
+        if acc[k] is not None:  # slots 9-10 are never produced (l3 max deg 3)
+            out[k - 6] = F2.add(out[k - 6], F2.mul_xi(acc[k]))
     return jnp.stack(out, axis=-3)
 
 

@@ -13,14 +13,21 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Pin the CPU codegen ISA: persistent-cache AOT entries compiled with
+# auto-detected machine features have been observed to SIGILL/segfault when
+# reloaded in a process that detects a different feature set.
+if "xla_cpu_max_isa" not in _flags:
+    _flags = (_flags + " --xla_cpu_max_isa=AVX2").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the crypto kernels (256-step scalar-mult
-# scans, Miller loops) are compile-heavy; cache them across test runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/drynx_jax_cache")
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NOTE: the on-disk persistent compilation cache is intentionally NOT enabled
+# here: jaxlib segfaults deserializing the very large crypto-kernel
+# executables (crash inside compilation_cache.get_executable_and_time when a
+# pairing kernel round-trips through the cache). Compile-time control comes
+# from small rolled field kernels + per-bucket jits (crypto/batching.py)
+# reused within the process instead.
