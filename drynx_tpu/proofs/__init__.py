@@ -1,0 +1,10 @@
+"""Zero-knowledge proof layer: range, obfuscation, aggregation, key-switch,
+shuffle proofs + the signed proof-request envelope.
+
+Mirrors the capabilities of the reference's lib/range, lib/obfuscation,
+lib/proof and the unlynx aggregation/keyswitch/shuffle proofs (SURVEY.md
+§2.1 #15-17, §2.2), re-designed for TPU: proofs over batches of values are
+fixed-shape limb tensors and every verification equation is a batched jitted
+kernel; only Fiat-Shamir hashing runs host-side.
+"""
+from . import encoding  # noqa: F401

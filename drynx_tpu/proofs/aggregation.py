@@ -1,0 +1,66 @@
+"""Aggregation proof: the published aggregate equals the homomorphic sum of
+the published inputs.
+
+Replaces unlynx AggregationListProofCreation/Verification (used by the
+reference at lib/proof/structs_proofs.go:188-264; hook at
+services/service.go:533-558). As in unlynx, the proof is transparent — it
+publishes inputs + output and verification recomputes the sum — but here the
+recomputation is one batched tree reduction on device instead of a per-element
+goroutine loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as C
+from . import encoding as enc
+
+
+@dataclasses.dataclass
+class AggregationProofBatch:
+    """Inputs (n_contrib, V, 2, 3, 16) + claimed aggregate (V, 2, 3, 16)."""
+
+    inputs: jnp.ndarray
+    aggregate: jnp.ndarray
+
+    def to_bytes(self) -> bytes:
+        n, V = int(self.inputs.shape[0]), int(self.inputs.shape[1])
+        head = np.asarray([n, V], dtype=np.int64).tobytes()
+        return head + (np.ascontiguousarray(enc.ct_bytes(self.inputs)).tobytes()
+                       + np.ascontiguousarray(
+                           enc.ct_bytes(self.aggregate)).tobytes())
+
+
+def create_aggregation_proof(inputs, aggregate) -> AggregationProofBatch:
+    return AggregationProofBatch(inputs=jnp.asarray(inputs),
+                                 aggregate=jnp.asarray(aggregate))
+
+
+def verify_aggregation_proof(proof: AggregationProofBatch) -> np.ndarray:
+    """Returns bool (V,): recomputed tree-reduced sum == claimed aggregate."""
+    from ..crypto import batching as B
+
+    acc = B.tree_reduce_add(proof.inputs, B.ct_add)
+    ok = C.eq(acc, jnp.asarray(proof.aggregate))  # (V, 2)
+    return np.asarray(jnp.all(ok, axis=-1))
+
+
+def verify_aggregation_list(proof: AggregationProofBatch,
+                            threshold: float) -> bool:
+    import math
+
+    V = int(proof.inputs.shape[1])
+    nbr = math.ceil(threshold * V)
+    if nbr == 0:
+        return True
+    sub = AggregationProofBatch(inputs=proof.inputs[:, :nbr],
+                                aggregate=proof.aggregate[:nbr])
+    return bool(np.all(verify_aggregation_proof(sub)))
+
+
+__all__ = ["AggregationProofBatch", "create_aggregation_proof",
+           "verify_aggregation_proof", "verify_aggregation_list"]

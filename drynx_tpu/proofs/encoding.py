@@ -1,0 +1,123 @@
+"""Canonical byte serialization for group elements + Fiat-Shamir hashing.
+
+The reference relies on kyber's MarshalBinary for hashing/signing (e.g.
+lib/range/range_proof.go:350-375 hashes B ‖ commit ‖ ΣY with sha3-512;
+lib/proof/structs_proofs.go:117 Schnorr-signs marshaled payloads). This
+framework defines its own canonical encoding, built directly from limb
+tensors with vectorized numpy (no bigint round trips):
+
+  scalar / Fp element : 32 bytes big-endian
+  G1 point            : x ‖ y (64 B), infinity = all-zero
+  G2 point            : x0 ‖ x1 ‖ y0 ‖ y1 (128 B), infinity = all-zero
+  GT element          : 6 Fp2 coeffs = 384 B
+
+All *_bytes functions accept batched device arrays and return uint8 numpy
+arrays with a trailing byte axis, so a (V, ...) batch hashes V messages with
+one device→host transfer.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as C
+from ..crypto import fp2 as F2
+from ..crypto import g2 as G2
+from ..crypto import field as F
+from ..crypto.field import FN, FP
+from ..crypto.params import LIMB_BITS, NUM_LIMBS
+
+
+def limbs_to_bytes(limbs) -> np.ndarray:
+    """(..., 16) uint32 little-endian limbs -> (..., 32) uint8 big-endian."""
+    a = np.asarray(limbs).astype(np.uint32)
+    rev = a[..., ::-1]  # most-significant limb first
+    hi = (rev >> 8).astype(np.uint8)
+    lo = (rev & 0xFF).astype(np.uint8)
+    return np.stack([hi, lo], axis=-1).reshape(a.shape[:-1] + (2 * NUM_LIMBS,))
+
+
+def bytes_to_limbs(b) -> np.ndarray:
+    """(..., 32) uint8 big-endian -> (..., 16) uint32 limbs."""
+    a = np.asarray(b, dtype=np.uint8).reshape(
+        np.asarray(b).shape[:-1] + (NUM_LIMBS, 2))
+    limbs = (a[..., 0].astype(np.uint32) << 8) | a[..., 1].astype(np.uint32)
+    return limbs[..., ::-1].copy()
+
+
+def scalar_bytes(s_limbs) -> np.ndarray:
+    return limbs_to_bytes(s_limbs)
+
+
+def g1_bytes(pts) -> np.ndarray:
+    """Jacobian Montgomery G1 (..., 3, 16) -> canonical (..., 64) uint8."""
+    x_m, y_m, inf = C.normalize(jnp.asarray(pts))
+    x = np.asarray(F.from_mont(x_m, FP))
+    y = np.asarray(F.from_mont(y_m, FP))
+    out = np.concatenate([limbs_to_bytes(x), limbs_to_bytes(y)], axis=-1)
+    out[np.asarray(inf)] = 0
+    return out
+
+
+def g2_bytes(pts) -> np.ndarray:
+    """Jacobian Montgomery G2 (..., 3, 2, 16) -> canonical (..., 128) uint8."""
+    x_m, y_m, inf = G2.normalize(jnp.asarray(pts))
+    parts = [np.asarray(F.from_mont(x_m[..., k, :], FP)) for k in range(2)]
+    parts += [np.asarray(F.from_mont(y_m[..., k, :], FP)) for k in range(2)]
+    out = np.concatenate([limbs_to_bytes(p) for p in parts], axis=-1)
+    out[np.asarray(inf)] = 0
+    return out
+
+
+def gt_bytes(f) -> np.ndarray:
+    """GT element (..., 6, 2, 16) Montgomery -> (..., 384) uint8."""
+    a = np.asarray(F.from_mont(jnp.asarray(f), FP))  # (..., 6, 2, 16)
+    b = limbs_to_bytes(a)  # (..., 6, 2, 32)
+    return b.reshape(b.shape[:-3] + (6 * 2 * 2 * NUM_LIMBS,))
+
+
+def ct_bytes(cts) -> np.ndarray:
+    """ElGamal ciphertexts (..., 2, 3, 16) -> (..., 128) uint8."""
+    b = g1_bytes(cts)  # (..., 2, 64)
+    return b.reshape(b.shape[:-2] + (128,))
+
+
+def hash_to_scalar(*chunks, batch_shape=()) -> np.ndarray:
+    """sha3-512 over concatenated canonical bytes -> mod-n scalar limbs.
+
+    Each chunk is a uint8 array either of shape (k,) (shared prefix) or
+    batch_shape + (k,) (per-element). Returns limbs batch_shape + (16,).
+    Mirrors the reference's sha3.New512 + Scalar.SetBytes Fiat-Shamir
+    (lib/range/range_proof.go:348-375).
+    """
+    from ..crypto import params
+
+    if not batch_shape:
+        h = hashlib.sha3_512()
+        for c in chunks:
+            h.update(np.ascontiguousarray(c).tobytes())
+        v = int.from_bytes(h.digest(), "big") % params.N
+        return F.from_int(v)
+
+    flat = int(np.prod(batch_shape))
+    exp = []
+    for c in chunks:
+        c = np.ascontiguousarray(c)
+        if c.shape[:-1] == tuple(batch_shape):
+            exp.append(c.reshape(flat, -1))
+        else:
+            exp.append(np.broadcast_to(c, (flat,) + c.shape).reshape(flat, -1))
+    out = np.zeros((flat, NUM_LIMBS), dtype=np.uint32)
+    for i in range(flat):
+        h = hashlib.sha3_512()
+        for c in exp:
+            h.update(c[i].tobytes())
+        v = int.from_bytes(h.digest(), "big") % params.N
+        out[i] = F.from_int(v)
+    return out.reshape(tuple(batch_shape) + (NUM_LIMBS,))
+
+
+__all__ = ["limbs_to_bytes", "bytes_to_limbs", "scalar_bytes", "g1_bytes",
+           "g2_bytes", "gt_bytes", "ct_bytes", "hash_to_scalar"]

@@ -1,0 +1,157 @@
+"""Key-switch ZK proof: a server proves its contribution
+(U, W) = (r·B, r·Q − x·K) is correct w.r.t. its public key Y = x·B.
+
+Replaces the unlynx KeySwitchListProofCreation/Verification used by the
+reference (lib/proof/structs_proofs.go:420-492; protocol hook at
+services/service.go:566-616). One proof batch covers every (server,
+ciphertext) pair: tensors are (ns, V, ...) and verification is one batched
+kernel.
+
+Sigma protocol per (server i, value j), with K the original ciphertext's
+randomness component and Q the target (querier) public key:
+  commit    A1 = wr·B, A2 = wr·Q − wx·K, A3 = wx·B     (wr, wx fresh)
+  challenge c = H(K ‖ U ‖ W ‖ Y ‖ Q ‖ A1 ‖ A2 ‖ A3)
+  response  zr = wr + c·r,  zx = wx + c·x
+  verify    zr·B == A1 + c·U
+            zr·Q − zx·K == A2 + c·W
+            zx·B == A3 + c·Y
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as C
+from ..crypto import elgamal as eg
+from ..crypto import field as F
+from ..crypto.field import FN
+from . import encoding as enc
+
+
+@dataclasses.dataclass
+class KeySwitchProofBatch:
+    """(ns, V) key-switch contribution proofs."""
+
+    orig_k: jnp.ndarray   # (V, 3, 16) original ciphertext K components
+    u_pts: jnp.ndarray    # (ns, V, 3, 16) contributions rB
+    w_pts: jnp.ndarray    # (ns, V, 3, 16) contributions rQ − xK
+    ys: jnp.ndarray       # (ns, 3, 16) server publics
+    q_pt: jnp.ndarray     # (3, 16) target public key
+    a1: jnp.ndarray       # (ns, V, 3, 16)
+    a2: jnp.ndarray       # (ns, V, 3, 16)
+    a3: jnp.ndarray       # (ns, V, 3, 16)
+    challenge: jnp.ndarray  # (ns, V, 16)
+    zr: jnp.ndarray       # (ns, V, 16)
+    zx: jnp.ndarray       # (ns, V, 16)
+
+    def to_bytes(self) -> bytes:
+        ns, V = int(self.u_pts.shape[0]), int(self.u_pts.shape[1])
+        head = np.asarray([ns, V], dtype=np.int64).tobytes()
+        parts = [enc.g1_bytes(self.orig_k), enc.g1_bytes(self.u_pts),
+                 enc.g1_bytes(self.w_pts), enc.g1_bytes(self.ys),
+                 enc.g1_bytes(self.q_pt), enc.g1_bytes(self.a1),
+                 enc.g1_bytes(self.a2), enc.g1_bytes(self.a3),
+                 enc.scalar_bytes(self.challenge), enc.scalar_bytes(self.zr),
+                 enc.scalar_bytes(self.zx)]
+        return head + b"".join(np.ascontiguousarray(p).tobytes()
+                               for p in parts)
+
+
+def _challenge(orig_k, u_pts, w_pts, ys, q_pt, a1, a2, a3) -> jnp.ndarray:
+    ns, V = u_pts.shape[0], u_pts.shape[1]
+    kb = np.broadcast_to(enc.g1_bytes(orig_k), (ns, V, 64))
+    yb = np.broadcast_to(enc.g1_bytes(ys)[:, None, :], (ns, V, 64))
+    qb = np.broadcast_to(enc.g1_bytes(q_pt), (ns, V, 64))
+    return jnp.asarray(enc.hash_to_scalar(
+        kb, enc.g1_bytes(u_pts), enc.g1_bytes(w_pts), yb, qb,
+        enc.g1_bytes(a1), enc.g1_bytes(a2), enc.g1_bytes(a3),
+        batch_shape=(ns, V)))
+
+
+@jax.jit
+def _commit_kernel(orig_k, q_tbl, wr, wx):
+    base = eg.BASE_TABLE.table
+    a1 = eg.fixed_base_mul(base, wr)
+    a2 = C.add(eg.fixed_base_mul(q_tbl, wr),
+               C.neg(C.scalar_mul(orig_k, wx)))
+    a3 = eg.fixed_base_mul(base, wx)
+    return a1, a2, a3
+
+
+@jax.jit
+def _response_kernel(wr, wx, c, r, x):
+    cm = F.to_mont(c, FN)
+    zr = F.add(wr, F.mont_mul(cm, r, FN), FN)
+    zx = F.add(wx, F.mont_mul(cm, x, FN), FN)
+    return zr, zx
+
+
+def create_keyswitch_proofs(key, orig_k, srv_x, ks_rs, q_pt, q_tbl,
+                            u_pts, w_pts) -> KeySwitchProofBatch:
+    """orig_k: (V, 3, 16); srv_x: (ns, 16) secrets; ks_rs: (ns, V, 16) the
+    key-switch randomness; q_pt/q_tbl: target pub point + fixed-base table;
+    u_pts/w_pts: (ns, V, 3, 16) the contributions actually produced by
+    parallel.keyswitch_contribution."""
+    ns, V = ks_rs.shape[0], ks_rs.shape[1]
+    k1, k2 = jax.random.split(key)
+    wr = eg.random_scalars(k1, (ns, V))
+    wx = eg.random_scalars(k2, (ns, V))
+    a1, a2, a3 = _commit_kernel(orig_k, q_tbl, wr, wx)
+    base = eg.BASE_TABLE.table
+    ys = eg.fixed_base_mul(base, jnp.asarray(srv_x))
+    c = _challenge(orig_k, u_pts, w_pts, ys, q_pt, a1, a2, a3)
+    zr, zx = _response_kernel(wr, wx, c, jnp.asarray(ks_rs),
+                              jnp.asarray(srv_x)[:, None, :])
+    return KeySwitchProofBatch(orig_k=jnp.asarray(orig_k), u_pts=u_pts,
+                               w_pts=w_pts, ys=ys, q_pt=jnp.asarray(q_pt),
+                               a1=a1, a2=a2, a3=a3, challenge=c, zr=zr, zx=zx)
+
+
+@jax.jit
+def _verify_kernel(orig_k, u_pts, w_pts, ys, q_tbl, a1, a2, a3, c, zr, zx):
+    base = eg.BASE_TABLE.table
+    ok1 = C.eq(eg.fixed_base_mul(base, zr),
+               C.add(a1, C.scalar_mul(u_pts, c)))
+    lhs2 = C.add(eg.fixed_base_mul(q_tbl, zr),
+                 C.neg(C.scalar_mul(orig_k, zx)))
+    ok2 = C.eq(lhs2, C.add(a2, C.scalar_mul(w_pts, c)))
+    ok3 = C.eq(eg.fixed_base_mul(base, zx),
+               C.add(a3, C.scalar_mul(ys[:, None], c)))
+    return ok1 & ok2 & ok3
+
+
+def verify_keyswitch_proofs(proof: KeySwitchProofBatch, q_tbl) -> np.ndarray:
+    """Returns bool (ns, V); recomputes the challenge."""
+    ok = np.asarray(_verify_kernel(
+        proof.orig_k, proof.u_pts, proof.w_pts, proof.ys, q_tbl, proof.a1,
+        proof.a2, proof.a3, proof.challenge, proof.zr, proof.zx))
+    want = np.asarray(_challenge(proof.orig_k, proof.u_pts, proof.w_pts,
+                                 proof.ys, proof.q_pt, proof.a1, proof.a2,
+                                 proof.a3))
+    return ok & np.all(np.asarray(proof.challenge) == want, axis=-1)
+
+
+def verify_keyswitch_list(proof: KeySwitchProofBatch, q_tbl,
+                          threshold: float) -> bool:
+    """Threshold-sampled verification over the value axis (reference samples
+    whole proofs at structs_proofs.go:471)."""
+    import math
+
+    V = int(proof.u_pts.shape[1])
+    nbr = math.ceil(threshold * V)
+    if nbr == 0:
+        return True
+    sub = KeySwitchProofBatch(
+        orig_k=proof.orig_k[:nbr], u_pts=proof.u_pts[:, :nbr],
+        w_pts=proof.w_pts[:, :nbr], ys=proof.ys, q_pt=proof.q_pt,
+        a1=proof.a1[:, :nbr], a2=proof.a2[:, :nbr], a3=proof.a3[:, :nbr],
+        challenge=proof.challenge[:, :nbr], zr=proof.zr[:, :nbr],
+        zx=proof.zx[:, :nbr])
+    return bool(np.all(verify_keyswitch_proofs(sub, q_tbl)))
+
+
+__all__ = ["KeySwitchProofBatch", "create_keyswitch_proofs",
+           "verify_keyswitch_proofs", "verify_keyswitch_list"]

@@ -1,0 +1,119 @@
+"""Obfuscation ZK proof: "the same secret scalar s multiplies both ElGamal
+components" (K' = s·K, C' = s·C).
+
+The reference builds this with kyber's proof DSL (proof.Rep/And,
+lib/obfuscation/obfuscation_proof.go:36-44) one ciphertext at a time inside a
+goroutine fan-out (:62-77). Here one proof object covers a whole ciphertext
+vector: commitments, challenges and responses are (V, ...) limb tensors and
+both create and verify are two batched device kernels around one host-side
+Fiat-Shamir hash.
+
+Sigma protocol per value:
+  commit   A1 = w·K, A2 = w·C            (w fresh random)
+  challenge c = H(K ‖ C ‖ K' ‖ C' ‖ A1 ‖ A2)
+  response  z = w + c·s
+  verify    z·K == A1 + c·K'  and  z·C == A2 + c·C'
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as C
+from ..crypto import elgamal as eg
+from ..crypto import field as F
+from ..crypto.field import FN
+from . import encoding as enc
+
+
+@dataclasses.dataclass
+class ObfuscationProofBatch:
+    """Mirrors PublishedListObfuscationProof (obfuscation_proof.go:20-33)
+    with the ciphertext axis batched."""
+
+    orig: jnp.ndarray     # (V, 2, 3, 16)
+    obf: jnp.ndarray      # (V, 2, 3, 16)
+    a1: jnp.ndarray       # (V, 3, 16) commitment w·K
+    a2: jnp.ndarray       # (V, 3, 16) commitment w·C
+    challenge: jnp.ndarray  # (V, 16)
+    z: jnp.ndarray        # (V, 16)
+
+    def to_bytes(self) -> bytes:
+        V = int(self.orig.shape[0])
+        head = np.asarray([V], dtype=np.int64).tobytes()
+        parts = [enc.ct_bytes(self.orig), enc.ct_bytes(self.obf),
+                 enc.g1_bytes(self.a1), enc.g1_bytes(self.a2),
+                 enc.scalar_bytes(self.challenge), enc.scalar_bytes(self.z)]
+        return head + b"".join(np.ascontiguousarray(p).tobytes()
+                               for p in parts)
+
+
+@jax.jit
+def _commit_kernel(ct, w):
+    K, Cc = ct[..., 0, :, :], ct[..., 1, :, :]
+    return C.scalar_mul(K, w), C.scalar_mul(Cc, w)
+
+
+@jax.jit
+def _response_kernel(w, c, s):
+    cs = F.mont_mul(F.to_mont(c, FN), s, FN)
+    return F.add(w, cs, FN)
+
+
+def _challenge(orig, obf, a1, a2) -> jnp.ndarray:
+    return jnp.asarray(enc.hash_to_scalar(
+        enc.ct_bytes(orig), enc.ct_bytes(obf), enc.g1_bytes(a1),
+        enc.g1_bytes(a2), batch_shape=orig.shape[:-3]))
+
+
+def create_obfuscation_proofs(key, ct, s) -> ObfuscationProofBatch:
+    """ct: (V, 2, 3, 16) pre-obfuscation; s: (V, 16) the secret scalars.
+    (Reference ObfuscationProofCreation, obfuscation_proof.go:47-59.)"""
+    obf = eg.ct_scalar_mul(ct, s)
+    w = eg.random_scalars(key, ct.shape[:-3])
+    a1, a2 = _commit_kernel(ct, w)
+    c = _challenge(ct, obf, a1, a2)
+    z = _response_kernel(w, c, s)
+    return ObfuscationProofBatch(orig=jnp.asarray(ct), obf=obf, a1=a1, a2=a2,
+                                 challenge=c, z=z)
+
+
+@jax.jit
+def _verify_kernel(orig, obf, a1, a2, c, z):
+    K, Cc = orig[..., 0, :, :], orig[..., 1, :, :]
+    Kp, Cp = obf[..., 0, :, :], obf[..., 1, :, :]
+    ok1 = C.eq(C.scalar_mul(K, z), C.add(a1, C.scalar_mul(Kp, c)))
+    ok2 = C.eq(C.scalar_mul(Cc, z), C.add(a2, C.scalar_mul(Cp, c)))
+    return ok1 & ok2
+
+
+def verify_obfuscation_proofs(proof: ObfuscationProofBatch) -> np.ndarray:
+    """Returns bool (V,). Recomputes the Fiat-Shamir challenge.
+    (Reference ObfuscationProofVerification, obfuscation_proof.go:80-91.)"""
+    ok = np.asarray(_verify_kernel(proof.orig, proof.obf, proof.a1, proof.a2,
+                                   proof.challenge, proof.z))
+    want = np.asarray(_challenge(proof.orig, proof.obf, proof.a1, proof.a2))
+    return ok & np.all(np.asarray(proof.challenge) == want, axis=-1)
+
+
+def verify_obfuscation_list(proof: ObfuscationProofBatch,
+                            threshold: float) -> bool:
+    """Threshold-sampled verification over the value axis (reference
+    ObfuscationListProofVerification, obfuscation_proof.go:94-110)."""
+    import math
+
+    V = int(proof.orig.shape[0])
+    nbr = math.ceil(threshold * V)
+    if nbr == 0:
+        return True
+    sub = ObfuscationProofBatch(
+        orig=proof.orig[:nbr], obf=proof.obf[:nbr], a1=proof.a1[:nbr],
+        a2=proof.a2[:nbr], challenge=proof.challenge[:nbr], z=proof.z[:nbr])
+    return bool(np.all(verify_obfuscation_proofs(sub)))
+
+
+__all__ = ["ObfuscationProofBatch", "create_obfuscation_proofs",
+           "verify_obfuscation_proofs", "verify_obfuscation_list"]

@@ -1,0 +1,107 @@
+"""Schnorr signatures on bn256 G1 with sha3-512 Fiat-Shamir.
+
+The reference Schnorr-signs every proof request payload and VNs check the
+signature before (sampled) payload verification (kyber sign/schnorr used at
+lib/proof/structs_proofs.go:117,498-505). Signing is a rare host-side event
+(once per proof request); verification is offered both host-side and as a
+batched device kernel for VN bulk checking.
+
+Scheme: R = k·B, c = H(R ‖ pub ‖ msg) mod n, s = k + c·x;
+verify: s·B == R + c·P.
+"""
+from __future__ import annotations
+
+import dataclasses
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as C
+from ..crypto import elgamal as eg
+from ..crypto import field as F
+from ..crypto import params, refimpl
+from . import encoding as enc
+
+
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    r_bytes: bytes  # canonical G1 point (64 B)
+    s_bytes: bytes  # canonical scalar (32 B)
+
+    def to_bytes(self) -> bytes:
+        return self.r_bytes + self.s_bytes
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Signature":
+        return cls(bytes(b[:64]), bytes(b[64:96]))
+
+
+def _point_bytes_host(pt) -> bytes:
+    """Host affine int pair (or None) -> canonical 64 bytes."""
+    if pt is None:
+        return b"\x00" * 64
+    x, y = pt
+    return int(x).to_bytes(32, "big") + int(y).to_bytes(32, "big")
+
+
+def _challenge(r_bytes: bytes, pub_bytes: bytes, msg: bytes) -> int:
+    import hashlib
+
+    h = hashlib.sha3_512()
+    h.update(r_bytes)
+    h.update(pub_bytes)
+    h.update(msg)
+    return int.from_bytes(h.digest(), "big") % params.N
+
+
+def sign(secret: int, msg: bytes, k: int | None = None) -> Signature:
+    """Schnorr-sign msg with secret scalar. Host-side (rare path)."""
+    if k is None:
+        k = secrets.randbelow(params.N - 1) + 1
+    R = refimpl.g1_mul(refimpl.G1, k)
+    pub = refimpl.g1_mul(refimpl.G1, secret)
+    r_bytes = _point_bytes_host(R)
+    c = _challenge(r_bytes, _point_bytes_host(pub), msg)
+    s = (k + c * secret) % params.N
+    return Signature(r_bytes, s.to_bytes(32, "big"))
+
+
+def verify(pub, msg: bytes, sig: Signature) -> bool:
+    """Host-side verification. pub: affine int pair."""
+    s = int.from_bytes(sig.s_bytes, "big")
+    c = _challenge(sig.r_bytes, _point_bytes_host(pub), msg)
+    rx = int.from_bytes(sig.r_bytes[:32], "big")
+    ry = int.from_bytes(sig.r_bytes[32:], "big")
+    R = None if (rx == 0 and ry == 0) else (rx, ry)
+    lhs = refimpl.g1_mul(refimpl.G1, s)
+    rhs = refimpl.g1_add(R, refimpl.g1_mul(pub, c))
+    return lhs == rhs
+
+
+def verify_batch(pubs, msgs: list[bytes], sigs: list[Signature]) -> np.ndarray:
+    """Batched device verification of many signatures (VN bulk path).
+
+    pubs: list of affine int pairs. Returns bool (n,).
+    """
+    n = len(sigs)
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    cs = np.zeros((n, params.NUM_LIMBS), dtype=np.uint32)
+    ss = np.zeros_like(cs)
+    Rs = np.zeros((n, 3, params.NUM_LIMBS), dtype=np.uint32)
+    Ps = np.zeros_like(Rs)
+    for i, (p, m, sg) in enumerate(zip(pubs, msgs, sigs)):
+        c = _challenge(sg.r_bytes, _point_bytes_host(p), m)
+        cs[i] = F.from_int(c)
+        ss[i] = enc.bytes_to_limbs(np.frombuffer(sg.s_bytes, dtype=np.uint8))
+        rx = int.from_bytes(sg.r_bytes[:32], "big")
+        ry = int.from_bytes(sg.r_bytes[32:], "big")
+        Rs[i] = C.from_ref(None if rx == 0 and ry == 0 else (rx, ry))
+        Ps[i] = C.from_ref(p)
+    lhs = eg.fixed_base_mul(eg.BASE_TABLE.table, jnp.asarray(ss))
+    rhs = C.add(jnp.asarray(Rs), C.scalar_mul(jnp.asarray(Ps), jnp.asarray(cs)))
+    return np.asarray(C.eq(lhs, rhs))
+
+
+__all__ = ["Signature", "sign", "verify", "verify_batch"]

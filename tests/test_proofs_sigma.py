@@ -1,0 +1,108 @@
+"""Obfuscation / key-switch / aggregation proofs + Schnorr + request layer."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from drynx_tpu.crypto import curve as C
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.parallel import collective as col
+from drynx_tpu.proofs import aggregation as ap
+from drynx_tpu.proofs import keyswitch as kp
+from drynx_tpu.proofs import obfuscation as op
+from drynx_tpu.proofs import requests as rq
+from drynx_tpu.proofs import schnorr
+
+RNG = np.random.default_rng(17)
+
+
+@pytest.fixture(scope="module")
+def keys():
+    x, pub = eg.keygen(RNG)
+    return x, pub, eg.pub_table(pub)
+
+
+def test_schnorr_sign_verify(keys):
+    x, pub, _ = keys
+    sig = schnorr.sign(x, b"hello drynx")
+    assert schnorr.verify(pub, b"hello drynx", sig)
+    assert not schnorr.verify(pub, b"tampered", sig)
+    got = schnorr.verify_batch([pub, pub], [b"a", b"b"],
+                               [schnorr.sign(x, b"a"), schnorr.sign(x, b"b")])
+    assert got.tolist() == [True, True]
+
+
+def test_obfuscation_proof_roundtrip(keys):
+    _, _, tbl = keys
+    vals = np.asarray([3, 0, 7], dtype=np.int64)
+    cts, _ = eg.encrypt_ints(jax.random.PRNGKey(1), tbl, vals)
+    s = eg.random_scalars(jax.random.PRNGKey(2), (3,))
+    proof = op.create_obfuscation_proofs(jax.random.PRNGKey(3), cts, s)
+    assert op.verify_obfuscation_proofs(proof).tolist() == [True] * 3
+    # tamper: claim a different obfuscated ciphertext
+    s2 = eg.random_scalars(jax.random.PRNGKey(4), (3,))
+    bad = op.ObfuscationProofBatch(
+        orig=proof.orig, obf=eg.ct_scalar_mul(cts, s2), a1=proof.a1,
+        a2=proof.a2, challenge=proof.challenge, z=proof.z)
+    assert not bool(np.all(op.verify_obfuscation_proofs(bad)))
+    assert op.verify_obfuscation_list(proof, threshold=0.5)
+
+
+def test_keyswitch_proof_roundtrip(keys):
+    x, pub, tbl = keys
+    ns, V = 3, 4
+    rng = np.random.default_rng(23)
+    secrets, pubs = zip(*[eg.keygen(rng) for _ in range(ns)])
+    srv_x = jnp.asarray(np.stack([eg.secret_to_limbs(s) for s in secrets]))
+    coll_tbl = eg.pub_table(col.collective_key(pubs))
+
+    vals = np.asarray([1, -2, 5, 0], dtype=np.int64)
+    cts, _ = eg.encrypt_ints(jax.random.PRNGKey(7), coll_tbl, vals)
+    ks_rs = eg.random_scalars(jax.random.PRNGKey(8), (ns, V))
+    u_pts, w_pts = jax.vmap(
+        lambda sx, r: col.keyswitch_contribution(cts, sx, r, tbl.table)
+    )(srv_x, ks_rs)
+
+    q_pt = jnp.asarray(C.from_ref(pub))
+    proof = kp.create_keyswitch_proofs(
+        jax.random.PRNGKey(9), cts[:, 0], srv_x, ks_rs, q_pt, tbl.table,
+        u_pts, w_pts)
+    ok = kp.verify_keyswitch_proofs(proof, tbl.table)
+    assert bool(np.all(ok)), ok
+
+    # a lying server (wrong secret in the contribution) must fail
+    bad_w = w_pts.at[0].set(w_pts[1])
+    bad = kp.create_keyswitch_proofs(
+        jax.random.PRNGKey(10), cts[:, 0], srv_x, ks_rs, q_pt, tbl.table,
+        u_pts, bad_w)
+    assert not bool(np.all(kp.verify_keyswitch_proofs(bad, tbl.table)))
+    assert kp.verify_keyswitch_list(proof, tbl.table, threshold=0.5)
+
+
+def test_aggregation_proof(keys):
+    _, _, tbl = keys
+    vals = np.asarray([[1, 2], [3, 4], [5, 6]], dtype=np.int64)  # 3 DPs, V=2
+    cts, _ = eg.encrypt_ints(jax.random.PRNGKey(11), tbl, vals)
+    agg = C.add(C.add(cts[0], cts[1]), cts[2])
+    proof = ap.create_aggregation_proof(cts, agg)
+    assert ap.verify_aggregation_proof(proof).tolist() == [True, True]
+    bad = ap.create_aggregation_proof(cts, C.add(agg, cts[0]))
+    assert not bool(np.all(ap.verify_aggregation_proof(bad)))
+    assert ap.verify_aggregation_list(proof, threshold=1.0)
+
+
+def test_proof_request_bitmap_codes(keys):
+    x, pub, _ = keys
+    req = rq.new_proof_request("aggregation", "sv1", "dp0", "g0", 0,
+                               b"payload-bytes", x)
+    rng = np.random.default_rng(0)
+    # good signature + always-sampled + passing payload -> BM_TRUE
+    assert rq.verify_proof_request(req, pub, 1.0, lambda d: True, rng) == rq.BM_TRUE
+    # failing payload -> BM_FALSE
+    assert rq.verify_proof_request(req, pub, 1.0, lambda d: False, rng) == rq.BM_FALSE
+    # sampling off -> BM_RECVD
+    assert rq.verify_proof_request(req, pub, 0.0, lambda d: True, rng) == rq.BM_RECVD
+    # wrong sender key -> BM_BADSIG
+    other = eg.keygen(np.random.default_rng(99))[1]
+    assert rq.verify_proof_request(req, other, 1.0, lambda d: True, rng) == rq.BM_BADSIG
+    assert req.storage_key() == "sv1/aggregation/dp0/g0"

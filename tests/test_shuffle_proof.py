@@ -1,0 +1,85 @@
+"""Verifiable shuffle proof: honest shuffle verifies, cheats are rejected."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from drynx_tpu.crypto import curve as C
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.crypto import field as F
+from drynx_tpu.crypto import params
+from drynx_tpu.proofs import shuffle as sp
+
+RNG = np.random.default_rng(5)
+K = 5
+
+
+@pytest.fixture(scope="module")
+def setup():
+    x, pub = eg.keygen(RNG)
+    tbl = eg.pub_table(pub)
+    h_pt = jnp.asarray(C.from_ref(pub))
+    vals = np.arange(K, dtype=np.int64)
+    cts, _ = eg.encrypt_ints(jax.random.PRNGKey(0), tbl, vals)
+    return tbl, h_pt, cts
+
+
+def _do_shuffle(cts, tbl, perm, betas):
+    """out[j] = cts[perm[j]] + Enc_beta[j](0)."""
+    shuffled = jnp.take(cts, jnp.asarray(perm), axis=0)
+    rs = jnp.asarray(np.stack([F.from_int(b) for b in betas]))
+    zero = eg.int_to_scalar(jnp.zeros((K,), dtype=jnp.int64))
+    zero_ct = eg.encrypt_with_tables(eg.BASE_TABLE.table, tbl.table, zero, rs)
+    return eg.ct_add(shuffled, zero_ct)
+
+
+def test_ilmpp_roundtrip():
+    rng = np.random.default_rng(3)
+    xs = [int(rng.integers(2, 1 << 60)) for _ in range(4)]
+    # ys with same product: permute xs and multiply/divide a pair
+    ys = [xs[1], xs[0], xs[3], xs[2]]
+    X = sp._base_muls(xs)
+    Y = sp._base_muls(ys)
+    proof = sp.ilmpp_prove(xs, ys, X, Y, rng)
+    assert sp.ilmpp_verify(proof, X, Y)
+    # different product must fail
+    ys_bad = list(ys)
+    ys_bad[0] = (ys_bad[0] + 1) % params.N
+    Y_bad = sp._base_muls(ys_bad)
+    bad = sp.ilmpp_prove(xs, ys_bad, X, Y_bad, rng)
+    assert not sp.ilmpp_verify(bad, X, Y_bad)
+
+
+def test_shuffle_proof_roundtrip(setup):
+    tbl, h_pt, cts = setup
+    rng = np.random.default_rng(9)
+    perm = rng.permutation(K)
+    betas = [int(rng.integers(1, 1 << 62)) for _ in range(K)]
+    out = _do_shuffle(cts, tbl, perm, betas)
+    proof = sp.prove_shuffle(cts, out, perm, betas, h_pt, rng)
+    assert sp.verify_shuffle(proof, cts, out, h_pt)
+    assert len(proof.to_bytes()) > 0
+
+
+def test_shuffle_proof_rejects_value_change(setup):
+    tbl, h_pt, cts = setup
+    rng = np.random.default_rng(11)
+    perm = rng.permutation(K)
+    betas = [int(rng.integers(1, 1 << 62)) for _ in range(K)]
+    out = _do_shuffle(cts, tbl, perm, betas)
+    # cheat: replace one output with an encryption of a different value
+    evil, _ = eg.encrypt_ints(jax.random.PRNGKey(5), tbl,
+                              np.asarray([99], dtype=np.int64))
+    out_bad = out.at[2].set(evil[0])
+    proof = sp.prove_shuffle(cts, out_bad, perm, betas, h_pt, rng)
+    assert not sp.verify_shuffle(proof, cts, out_bad, h_pt)
+
+
+def test_shuffle_proof_rejects_duplicate(setup):
+    tbl, h_pt, cts = setup
+    rng = np.random.default_rng(13)
+    perm = np.asarray([0, 0, 2, 3, 4])  # not a permutation: duplicates 0
+    betas = [int(rng.integers(1, 1 << 62)) for _ in range(K)]
+    out = _do_shuffle(cts, tbl, perm, betas)
+    proof = sp.prove_shuffle(cts, out, perm, betas, h_pt, rng)
+    assert not sp.verify_shuffle(proof, cts, out, h_pt)
