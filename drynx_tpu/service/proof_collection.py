@@ -1,0 +1,133 @@
+"""VN-side proof collection: receive signed proofs, verify (sampled), build
+the per-survey bitmap, persist everything, and commit an audit block.
+
+Mirrors the reference's ProofCollectionProtocol + VN service state
+(protocols/proof_collection_protocol.go:84-406,
+services/service_skipchain.go:31-170): each VN keeps, per survey, the
+expected proof count (from query_to_proofs_nbrs), a bitmap mapping proof keys
+to codes, and a proofdb bucket of raw proof bytes; when the counter reaches
+zero the root VN aggregates every VN's bitmap into one DataBlock and appends
+it to the audit chain; the querier can then block on `wait_done`.
+
+Topology note: the reference delivers proofs over a star onet tree
+(prover -> all VNs). In-process, delivery is a direct fan-out to each
+VerifyingNode; across hosts it rides the gRPC/DCN control plane — either way
+the verification math itself is the batched TPU kernels in drynx_tpu.proofs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..proofs import requests as rq
+from .skipchain import DataBlock, SkipChain, bitmap_verifier
+from .store import ProofDB
+
+
+@dataclasses.dataclass
+class SurveyProofState:
+    expected: int                      # total proofs this VN will receive
+    bitmap: dict[str, int] = dataclasses.field(default_factory=dict)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+
+class VerifyingNode:
+    """One VN: verifies incoming proof envelopes and tracks bitmaps."""
+
+    def __init__(self, name: str, db_path: str,
+                 pubs: dict[str, tuple],
+                 verify_fns: Optional[dict[str, Callable[[bytes], bool]]] = None,
+                 seed: int = 0):
+        self.name = name
+        self.db = ProofDB(db_path)
+        self.pubs = pubs                      # sender id -> G1 affine pub
+        self.verify_fns = verify_fns or {}    # proof type -> payload verifier
+        self.rng = np.random.default_rng(seed)
+        self.surveys: dict[str, SurveyProofState] = {}
+        self.local_bitmaps: dict[str, dict[str, int]] = {}
+        self.chain = SkipChain(self.db,
+                               [bitmap_verifier(self.local_bitmaps)])
+        self._lock = threading.Lock()
+
+    # -- reference HandleSurveyQueryToVN (service_skipchain.go:31-93)
+    def register_survey(self, survey_id: str, expected_proofs: int,
+                        thresholds: dict[str, float]) -> None:
+        with self._lock:
+            self.surveys[survey_id] = SurveyProofState(expected=expected_proofs)
+            self.thresholds = getattr(self, "thresholds", {})
+            self.thresholds[survey_id] = thresholds
+
+    # -- reference ProofCollectionProtocol.Dispatch + storeProof (:183-406)
+    def receive_proof(self, req: rq.ProofRequest) -> int:
+        st = self.surveys.get(req.survey_id)
+        if st is None:
+            raise KeyError(f"unknown survey {req.survey_id!r}")
+        sample = self.thresholds.get(req.survey_id, {}).get(req.proof_type, 1.0)
+        pub = self.pubs.get(req.sender_id)
+        code = (rq.BM_BADSIG if pub is None else rq.verify_proof_request(
+            req, pub, sample, self.verify_fns.get(req.proof_type), self.rng))
+        key = req.storage_key()
+        with self._lock:
+            st.bitmap[key] = code
+            self.db.put(key, req.data)
+            remaining = st.expected - len(st.bitmap)
+        if remaining <= 0:
+            st.done.set()
+        return code
+
+    def bitmap_for(self, survey_id: str) -> dict[str, int]:
+        st = self.surveys[survey_id]
+        return dict(st.bitmap)
+
+    def stored_proofs(self, survey_id: str) -> dict[str, bytes]:
+        """Reference HandleGetProofs (service_skipchain.go:240-320)."""
+        out = {}
+        for k in self.db.keys():
+            ks = k.decode(errors="replace")
+            if ks.startswith(survey_id + "/"):
+                out[ks] = self.db.get(k)
+        return out
+
+
+class VNGroup:
+    """The VN roster: root VN aggregates bitmaps and commits the block
+    (reference service_skipchain.go:95-170)."""
+
+    def __init__(self, vns: list[VerifyingNode]):
+        if not vns:
+            raise ValueError("empty VN roster")
+        self.vns = vns
+        self.root = vns[0]
+
+    def register_survey(self, survey_id: str, expected_proofs: int,
+                        thresholds: dict[str, float]) -> None:
+        for vn in self.vns:
+            vn.register_survey(survey_id, expected_proofs, thresholds)
+
+    def deliver(self, req: rq.ProofRequest) -> list[int]:
+        """Star fan-out: every VN receives and verifies the proof."""
+        return [vn.receive_proof(req) for vn in self.vns]
+
+    def end_verification(self, survey_id: str, timeout: float = 60.0):
+        """Blocks until all proofs arrived at every VN, then the root VN
+        funnels bitmaps together and commits one audit block (reference
+        HandleEndVerification + the bitmap-aggregation goroutine)."""
+        for vn in self.vns:
+            if not vn.surveys[survey_id].done.wait(timeout):
+                raise TimeoutError(
+                    f"VN {vn.name}: proofs incomplete for {survey_id!r}")
+        merged: dict[str, int] = {}
+        for vn in self.vns:
+            for k, v in vn.bitmap_for(survey_id).items():
+                merged[f"{vn.name}:{k}"] = v
+        block_data = DataBlock(survey_id=survey_id, sample_time=time.time(),
+                               bitmap=merged)
+        self.root.local_bitmaps[survey_id] = merged
+        return self.root.chain.append(block_data)
+
+
+__all__ = ["SurveyProofState", "VerifyingNode", "VNGroup"]

@@ -1,0 +1,156 @@
+"""Proof store: ctypes binding to the native C++ append-only KV log.
+
+Replaces the reference's bbolt embedded store (OpenDB at
+services/service_skipchain.go:489, puts at
+protocols/proof_collection_protocol.go:318-359). The native library is
+compiled on demand with g++ (no pip deps); if the toolchain is unavailable a
+pure-Python fallback with the same API keeps tests running.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                    "proofdb.cpp")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                        "build")
+_LIB_PATH = os.path.join(_LIB_DIR, "libproofdb.so")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+_LIB_FAILED = False
+
+
+def _load_lib():
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        try:
+            if (not os.path.exists(_LIB_PATH)
+                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                os.makedirs(_LIB_DIR, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", _LIB_PATH],
+                    check=True, capture_output=True)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.pdb_open.restype = ctypes.c_void_p
+            lib.pdb_open.argtypes = [ctypes.c_char_p]
+            lib.pdb_put.restype = ctypes.c_int
+            lib.pdb_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint32, ctypes.c_char_p,
+                                    ctypes.c_uint32]
+            lib.pdb_get.restype = ctypes.c_int64
+            lib.pdb_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint32, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+            lib.pdb_count.restype = ctypes.c_int64
+            lib.pdb_count.argtypes = [ctypes.c_void_p]
+            lib.pdb_key_at.restype = ctypes.c_int64
+            lib.pdb_key_at.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_char_p, ctypes.c_uint64]
+            lib.pdb_sync.restype = ctypes.c_int
+            lib.pdb_sync.argtypes = [ctypes.c_void_p]
+            lib.pdb_close.restype = None
+            lib.pdb_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+    return _LIB
+
+
+class ProofDB:
+    """Keyed byte store, last-write-wins, persistent across reopen."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        lib = _load_lib()
+        if lib is not None:
+            self._h = lib.pdb_open(path.encode())
+            self._lib = lib
+            if not self._h:
+                raise OSError(f"proofdb: cannot open {path}")
+        else:  # pure-Python fallback
+            self._h = None
+            self._lib = None
+            self._mem: dict[bytes, bytes] = {}
+            self._order: list[bytes] = []
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    buf = f.read()
+                off = 0
+                while off + 8 <= len(buf):
+                    klen = int.from_bytes(buf[off:off + 4], "little")
+                    vlen = int.from_bytes(buf[off + 4:off + 8], "little")
+                    k = buf[off + 8:off + 8 + klen]
+                    v = buf[off + 8 + klen:off + 8 + klen + vlen]
+                    if len(v) < vlen:
+                        break
+                    if k not in self._mem:
+                        self._order.append(k)
+                    self._mem[k] = v
+                    off += 8 + klen + vlen
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def put(self, key: str | bytes, value: bytes) -> None:
+        k = key.encode() if isinstance(key, str) else key
+        with self._lock:
+            if self._lib is not None:
+                rc = self._lib.pdb_put(self._h, k, len(k), value, len(value))
+                if rc != 0:
+                    raise OSError("proofdb put failed")
+            else:
+                with open(self.path, "ab") as f:
+                    f.write(len(k).to_bytes(4, "little")
+                            + len(value).to_bytes(4, "little") + k + value)
+                if k not in self._mem:
+                    self._order.append(k)
+                self._mem[k] = value
+
+    def get(self, key: str | bytes) -> bytes | None:
+        k = key.encode() if isinstance(key, str) else key
+        with self._lock:
+            if self._lib is not None:
+                n = self._lib.pdb_get(self._h, k, len(k), None, 0)
+                if n < 0:
+                    return None
+                buf = ctypes.create_string_buffer(int(n))
+                self._lib.pdb_get(self._h, k, len(k), buf, n)
+                return buf.raw[:n]
+            return self._mem.get(k)
+
+    def keys(self) -> list[bytes]:
+        with self._lock:
+            if self._lib is not None:
+                out = []
+                count = self._lib.pdb_count(self._h)
+                for i in range(count):
+                    n = self._lib.pdb_key_at(self._h, i, None, 0)
+                    buf = ctypes.create_string_buffer(int(n))
+                    self._lib.pdb_key_at(self._h, i, buf, n)
+                    out.append(buf.raw[:n])
+                return out
+            return list(self._order)
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._lib is not None:
+                self._lib.pdb_sync(self._h)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._lib is not None and self._h:
+                self._lib.pdb_close(self._h)
+                self._h = None
+
+
+__all__ = ["ProofDB"]

@@ -1,0 +1,131 @@
+// proofdb — append-only key/value proof log with an in-memory index.
+//
+// Native equivalent of the reference's bbolt proof store (proof bytes are
+// written per surveyID/type/sender key at reference
+// protocols/proof_collection_protocol.go:318-359 and read back via
+// services/service_skipchain.go:240-320). ZK proof batches are megabytes of
+// limb tensors, so the write path is a single sequential append + index
+// insert; reads are pread() at the indexed offset, no deserialization.
+//
+// Record format (little-endian): [u32 klen][u32 vlen][key bytes][val bytes]
+// A put for an existing key appends a new record and repoints the index
+// (last-write-wins), like bbolt bucket puts.
+//
+// Built as a shared library (see drynx_tpu/service/store.py); exposes a flat
+// C ABI for ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Entry {
+  uint64_t offset;  // offset of value bytes
+  uint32_t vlen;
+};
+
+struct DB {
+  int fd = -1;
+  uint64_t size = 0;  // current end-of-log offset
+  std::unordered_map<std::string, Entry> index;
+  std::vector<std::string> keys;  // insertion order (first-put order)
+};
+
+bool read_exact(int fd, uint64_t off, void* buf, size_t n) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t r = pread(fd, static_cast<char*>(buf) + done, n - done, off + done);
+    if (r <= 0) return false;
+    done += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pdb_open(const char* path) {
+  int fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return nullptr;
+  DB* db = new DB();
+  db->fd = fd;
+  off_t end = ::lseek(fd, 0, SEEK_END);
+  db->size = end < 0 ? 0 : static_cast<uint64_t>(end);
+  // rebuild index by scanning the log
+  uint64_t off = 0;
+  while (off + 8 <= db->size) {
+    uint32_t lens[2];
+    if (!read_exact(fd, off, lens, 8)) break;
+    uint64_t koff = off + 8, voff = koff + lens[0];
+    if (voff + lens[1] > db->size) break;  // truncated tail record: ignore
+    std::string key(lens[0], '\0');
+    if (!read_exact(fd, koff, key.data(), lens[0])) break;
+    auto it = db->index.find(key);
+    if (it == db->index.end()) db->keys.push_back(key);
+    db->index[key] = Entry{voff, lens[1]};
+    off = voff + lens[1];
+  }
+  return db;
+}
+
+int pdb_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val,
+            uint32_t vlen) {
+  DB* db = static_cast<DB*>(h);
+  uint32_t lens[2] = {klen, vlen};
+  uint64_t off = db->size;
+  if (pwrite(db->fd, lens, 8, off) != 8) return -1;
+  if (pwrite(db->fd, key, klen, off + 8) != static_cast<ssize_t>(klen))
+    return -1;
+  if (pwrite(db->fd, val, vlen, off + 8 + klen) != static_cast<ssize_t>(vlen))
+    return -1;
+  db->size = off + 8 + klen + vlen;
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  auto it = db->index.find(k);
+  if (it == db->index.end()) db->keys.push_back(k);
+  db->index[k] = Entry{off + 8 + klen, vlen};
+  return 0;
+}
+
+// returns value length, or -1 if missing; copies min(vlen, cap) bytes.
+int64_t pdb_get(void* h, const uint8_t* key, uint32_t klen, uint8_t* out,
+                uint64_t cap) {
+  DB* db = static_cast<DB*>(h);
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  auto it = db->index.find(k);
+  if (it == db->index.end()) return -1;
+  uint64_t n = it->second.vlen < cap ? it->second.vlen : cap;
+  if (n > 0 && !read_exact(db->fd, it->second.offset, out, n)) return -1;
+  return static_cast<int64_t>(it->second.vlen);
+}
+
+int64_t pdb_count(void* h) {
+  return static_cast<int64_t>(static_cast<DB*>(h)->keys.size());
+}
+
+// key at index i (first-put order); returns key length or -1.
+int64_t pdb_key_at(void* h, int64_t i, uint8_t* out, uint64_t cap) {
+  DB* db = static_cast<DB*>(h);
+  if (i < 0 || static_cast<size_t>(i) >= db->keys.size()) return -1;
+  const std::string& k = db->keys[static_cast<size_t>(i)];
+  uint64_t n = k.size() < cap ? k.size() : cap;
+  memcpy(out, k.data(), n);
+  return static_cast<int64_t>(k.size());
+}
+
+int pdb_sync(void* h) { return fsync(static_cast<DB*>(h)->fd); }
+
+void pdb_close(void* h) {
+  DB* db = static_cast<DB*>(h);
+  if (db->fd >= 0) ::close(db->fd);
+  delete db;
+}
+
+}  // extern "C"
