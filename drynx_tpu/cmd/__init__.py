@@ -1,0 +1,2 @@
+"""CLI entry points (reference cmd/server, cmd/client): TOML-over-stdin/
+stdout config pipeline driving surveys."""

@@ -1,0 +1,75 @@
+"""Server CLI: `gen` emits a node config (TOML, stdout); `run` boots the node.
+
+Mirrors the reference cmd/server (main.go:42-126): `server gen` creates the
+keypair + address config on stdout; `server run` reads the config from stdin
+and serves until killed. One binary, role decided by the roster
+(cmd/README.md:13-18).
+
+Usage:
+  python -m drynx_tpu.cmd.server gen --address 127.0.0.1:7000 --name cn0
+  python -m drynx_tpu.cmd.server run < node.toml
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from ..crypto import elgamal as eg
+from . import toml_io
+
+
+def cmd_gen(args) -> int:
+    host, _, port = args.address.partition(":")
+    rng = np.random.default_rng()
+    secret, public = eg.keygen(rng)
+    cfg = {"node": {
+        "name": args.name,
+        "host": host or "127.0.0.1",
+        "port": int(port or 0),
+        "secret": hex(secret),
+        "public_x": hex(public[0]),
+        "public_y": hex(public[1]),
+    }}
+    sys.stdout.write(toml_io.dumps(cfg))
+    return 0
+
+
+def cmd_run(args) -> int:
+    from ..service.node import DrynxNode
+
+    cfg = toml_io.loads(sys.stdin.read())["node"]
+    data = None
+    if args.data:
+        data = np.loadtxt(args.data, dtype=np.int64, ndmin=1)
+    node = DrynxNode(cfg["name"], int(cfg["secret"], 16),
+                     (int(cfg["public_x"], 16), int(cfg["public_y"], 16)),
+                     host=cfg.get("host", "127.0.0.1"),
+                     port=int(cfg.get("port", 0)), data=data)
+    print(f"drynx node {cfg['name']} listening on "
+          f"{node.address[0]}:{node.address[1]}", file=sys.stderr, flush=True)
+    try:
+        node.server.serve_forever()
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="drynx-server")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("gen", help="generate node config TOML on stdout")
+    g.add_argument("--address", default="127.0.0.1:0")
+    g.add_argument("--name", default="node")
+    g.set_defaults(fn=cmd_gen)
+    r = sub.add_parser("run", help="run node from config TOML on stdin")
+    r.add_argument("--data", default=None,
+                   help="path to this DP's local data (one int per line)")
+    r.set_defaults(fn=cmd_run)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""Minimal TOML emit/parse for the CLI config pipeline.
+
+The reference pipes TOML configs through stdin/stdout between composable
+commands (cmd/README.md:7-9, cmd/client/config.go:14-123). Python ships a
+TOML reader (tomllib) but no writer, so a small emitter for our config shape
+(tables, arrays of tables, scalar/list values) lives here.
+"""
+from __future__ import annotations
+
+import tomllib
+
+
+def loads(text: str) -> dict:
+    return tomllib.loads(text)
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_fmt_val(x) for x in v) + "]"
+    raise TypeError(f"unsupported TOML value {type(v)}")
+
+
+def dumps(d: dict) -> str:
+    out = []
+    tables = []
+    for k, v in d.items():
+        if isinstance(v, dict):
+            tables.append((k, [v], False))
+        elif isinstance(v, list) and v and all(isinstance(x, dict) for x in v):
+            tables.append((k, v, True))
+        else:
+            out.append(f"{k} = {_fmt_val(v)}")
+    for name, items, is_array in tables:
+        for item in items:
+            out.append("")
+            out.append(f"[[{name}]]" if is_array else f"[{name}]")
+            for k, v in item.items():
+                out.append(f"{k} = {_fmt_val(v)}")
+    return "\n".join(out) + "\n"
+
+
+__all__ = ["loads", "dumps"]

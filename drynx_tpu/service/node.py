@@ -1,0 +1,297 @@
+"""Multi-process node roles over the TCP control plane.
+
+One process = one node; the role (CN / DP / VN) is decided by roster
+position, exactly like the reference's single binary (cmd/README.md:13-18).
+The message flow mirrors SURVEY.md §3.1:
+
+  client ──survey_query──▶ root CN
+     root CN ──survey_dp──▶ each DP     (encode + encrypt locally)
+     root CN aggregates ciphertexts     (device kernels)
+     root CN ──ks_contrib──▶ each CN    (partial decrypt + re-encrypt)
+     root CN ◀─ contributions, assembles switched ciphertext
+  client ◀── switched ciphertext, decrypts with its own key
+
+Proof envelopes go prover ──proof_request──▶ every VN;
+the root VN aggregates bitmaps (vn_bitmap) and commits the audit block.
+"""
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import secrets
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import batching as B
+from ..crypto import elgamal as eg
+from ..crypto import refimpl
+from ..encoding import stats as st
+from ..proofs import requests as rq
+from ..proofs import schnorr
+from .proof_collection import VerifyingNode
+from .skipchain import DataBlock
+from .transport import Conn, NodeServer, pack_array, unpack_array
+
+
+@dataclasses.dataclass
+class RosterEntry:
+    name: str
+    role: str          # "cn" | "dp" | "vn"
+    host: str
+    port: int
+    public: tuple      # affine ints
+
+
+@dataclasses.dataclass
+class Roster:
+    entries: list
+
+    def of_role(self, role: str) -> list:
+        return [e for e in self.entries if e.role == role]
+
+    def collective_pub(self) -> tuple:
+        acc = None
+        for e in self.of_role("cn"):
+            acc = refimpl.g1_add(acc, e.public)
+        return acc
+
+    def to_dict(self) -> dict:
+        return {"entries": [dataclasses.asdict(e) for e in self.entries]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Roster":
+        return cls([RosterEntry(**{**e, "public": tuple(e["public"])})
+                    for e in d["entries"]])
+
+
+class DrynxNode:
+    """A node process serving its role's handlers."""
+
+    def __init__(self, name: str, secret: int, public: tuple,
+                 host: str = "127.0.0.1", port: int = 0,
+                 data: Optional[np.ndarray] = None,
+                 db_path: Optional[str] = None):
+        self.name = name
+        self.secret = secret
+        self.public = public
+        self.data = data
+        self.server = NodeServer(host, port)
+        self.roster: Optional[Roster] = None
+        self.vn: Optional[VerifyingNode] = None
+        self._db_path = db_path or f"/tmp/drynx_node_{name}.db"
+
+        s = self.server
+        s.register("set_roster", self._h_set_roster)
+        s.register("survey_query", self._h_survey_query)
+        s.register("survey_dp", self._h_survey_dp)
+        s.register("ks_contrib", self._h_ks_contrib)
+        s.register("proof_request", self._h_proof_request)
+        s.register("vn_register", self._h_vn_register)
+        s.register("vn_bitmap", self._h_vn_bitmap)
+        s.register("end_verification", self._h_end_verification)
+        s.register("ping", lambda m: {"ok": True, "name": self.name})
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self):
+        return self.server.host, self.server.port
+
+    def start(self):
+        self.server.start()
+
+    def stop(self):
+        self.server.stop()
+
+    def _conn(self, entry: RosterEntry) -> Conn:
+        return Conn(entry.host, entry.port)
+
+    # ------------------------------------------------------------------
+    def _h_set_roster(self, msg: dict) -> dict:
+        self.roster = Roster.from_dict(msg["roster"])
+        me = [e for e in self.roster.entries if e.name == self.name]
+        if me and me[0].role == "vn" and self.vn is None:
+            pubs = {e.name: e.public for e in self.roster.entries}
+            self.vn = VerifyingNode(self.name, self._db_path, pubs,
+                                    verify_fns={}, seed=0)
+        return {"ok": True}
+
+    def _pub_table(self, pub: tuple) -> eg.FixedBase:
+        """Fixed-base tables are key-lifetime objects: cache per affine point
+        (building one costs ~1k host-side bigint point adds)."""
+        cache = getattr(self, "_tbl_cache", None)
+        if cache is None:
+            cache = self._tbl_cache = {}
+        if pub not in cache:
+            cache[pub] = eg.pub_table(pub)
+        return cache[pub]
+
+    # -- DP side: encode + encrypt local data (survey_dp)
+    def _h_survey_dp(self, msg: dict) -> dict:
+        op = msg["op"]
+        qmin, qmax = msg["query_min"], msg["query_max"]
+        data = self.data
+        if data is None:
+            rng = np.random.default_rng(abs(hash(self.name)) % 2**31)
+            data = rng.integers(qmin, max(qmax, 1), size=(32,)).astype(np.int64)
+        stats = np.asarray(st.encode_clear(op, data, qmin, qmax))
+        tbl = self._pub_table(self.roster.collective_pub())
+        # fresh OS entropy: blinding scalars must never be derivable from
+        # survey metadata, and must differ across runs of the same survey
+        key = jax.random.PRNGKey(secrets.randbits(63))
+        cts, _ = eg.encrypt_ints(key, tbl, jnp.asarray(stats))
+        return {"cts": pack_array(np.asarray(cts))}
+
+    # -- CN side: key-switch contribution for an aggregate
+    def _h_ks_contrib(self, msg: dict) -> dict:
+        K0 = jnp.asarray(unpack_array(msg["k_component"]))   # (V, 3, 16)
+        client_pub = tuple(msg["client_pub"])
+        q_tbl = self._pub_table(client_pub)
+        V = K0.shape[0]
+        key = jax.random.PRNGKey(secrets.randbits(63))
+        rs = eg.random_scalars(key, (V,))
+        x = jnp.asarray(eg.secret_to_limbs(self.secret))
+        u_pts = B.fixed_base_mul(eg.BASE_TABLE.table, rs)
+        rQ = B.fixed_base_mul(q_tbl.table, rs)
+        xK = B.g1_scalar_mul(K0, x)
+        w_pts = B.g1_add(rQ, B.g1_neg(xK))
+        return {"u": pack_array(np.asarray(u_pts)),
+                "w": pack_array(np.asarray(w_pts))}
+
+    # -- root CN: the whole survey
+    def _h_survey_query(self, msg: dict) -> dict:
+        assert self.roster is not None, "roster not set"
+        op = msg["op"]
+        survey_id = msg["survey_id"]
+        dps = self.roster.of_role("dp")
+        cns = self.roster.of_role("cn")
+
+        # collect encrypted DP responses (star topology)
+        cts = []
+        for e in dps:
+            with_conn = self._conn(e)
+            try:
+                r = with_conn.call({"type": "survey_dp", "op": op,
+                                    "survey_id": survey_id,
+                                    "query_min": msg["query_min"],
+                                    "query_max": msg["query_max"]})
+            finally:
+                with_conn.close()
+            cts.append(unpack_array(r["cts"]))
+        cts = jnp.asarray(np.stack(cts))                     # (n_dps, V, 2,3,16)
+        agg = B.tree_reduce_add(cts, B.ct_add)
+
+        # key switch: gather contributions from every CN (including self)
+        K0 = np.asarray(agg[:, 0])
+        k_sum = c_sum = None
+        for e in cns:
+            if e.name == self.name:
+                r = self._h_ks_contrib({"k_component": pack_array(K0),
+                                        "client_pub": list(msg["client_pub"]),
+                                        "survey_id": survey_id})
+            else:
+                conn = self._conn(e)
+                try:
+                    r = conn.call({"type": "ks_contrib",
+                                   "k_component": pack_array(K0),
+                                   "client_pub": list(msg["client_pub"]),
+                                   "survey_id": survey_id})
+                finally:
+                    conn.close()
+            u = jnp.asarray(unpack_array(r["u"]))
+            w = jnp.asarray(unpack_array(r["w"]))
+            k_sum = u if k_sum is None else B.g1_add(k_sum, u)
+            c_sum = w if c_sum is None else B.g1_add(c_sum, w)
+
+        switched = jnp.stack([k_sum, B.g1_add(agg[:, 1], c_sum)], axis=-3)
+        return {"switched": pack_array(np.asarray(switched))}
+
+    # -- VN handlers
+    def _h_vn_register(self, msg: dict) -> dict:
+        self.vn.register_survey(msg["survey_id"], msg["expected"],
+                                msg.get("thresholds", {}))
+        return {"ok": True}
+
+    def _h_proof_request(self, msg: dict) -> dict:
+        req = rq.ProofRequest(
+            proof_type=msg["proof_type"], survey_id=msg["survey_id"],
+            sender_id=msg["sender_id"], differ_info=msg["differ_info"],
+            round_id=msg["round_id"], data=unpack_array(msg["data"]).tobytes(),
+            signature=schnorr.Signature.from_bytes(
+                unpack_array(msg["signature"]).tobytes()))
+        code = self.vn.receive_proof(req)
+        return {"code": code}
+
+    def _h_vn_bitmap(self, msg: dict) -> dict:
+        return {"bitmap": self.vn.bitmap_for(msg["survey_id"])}
+
+    def _h_end_verification(self, msg: dict) -> dict:
+        survey_id = msg["survey_id"]
+        vns = self.roster.of_role("vn")
+        merged = {}
+        for e in vns:
+            if e.name == self.name:
+                bm = self.vn.bitmap_for(survey_id)
+            else:
+                conn = self._conn(e)
+                try:
+                    bm = conn.call({"type": "vn_bitmap",
+                                    "survey_id": survey_id})["bitmap"]
+                finally:
+                    conn.close()
+            for k, v in bm.items():
+                merged[f"{e.name}:{k}"] = v
+        import time as _time
+
+        self.vn.local_bitmaps[survey_id] = merged
+        block = self.vn.chain.append(
+            DataBlock(survey_id=survey_id, sample_time=_time.time(),
+                      bitmap=merged))
+        return {"block_index": block.index, "block_hash": block.hash(),
+                "bitmap": merged}
+
+
+class RemoteClient:
+    """Querier for a multi-process deployment."""
+
+    def __init__(self, roster: Roster, rng: Optional[np.random.Generator] = None):
+        self.roster = roster
+        rng = rng or np.random.default_rng()
+        self.secret, self.public = eg.keygen(rng)
+
+    def broadcast_roster(self):
+        for e in self.roster.entries:
+            c = Conn(e.host, e.port)
+            try:
+                c.call({"type": "set_roster", "roster": self.roster.to_dict()})
+            finally:
+                c.close()
+
+    def run_survey(self, op: str, query_min: int = 0, query_max: int = 0,
+                   survey_id: str = "sv-remote",
+                   dlog: Optional[eg.DecryptionTable] = None):
+        root = self.roster.of_role("cn")[0]
+        conn = Conn(root.host, root.port)
+        try:
+            r = conn.call({"type": "survey_query", "op": op,
+                           "survey_id": survey_id,
+                           "query_min": query_min, "query_max": query_max,
+                           "client_pub": list(self.public)})
+        finally:
+            conn.close()
+        switched = jnp.asarray(unpack_array(r["switched"]))
+        dl = dlog or eg.DecryptionTable(limit=10000)
+        xq = jnp.asarray(eg.secret_to_limbs(self.secret))
+        pts = B.decrypt_point(switched, xq)
+        vals, found = B.table_lookup(dl.keys, dl.xs, dl.ysign, dl.vals, pts)
+        zeros = B.is_infinity(pts)
+        dec = st.DecryptedVector(values=np.asarray(vals),
+                                 found=np.asarray(found),
+                                 is_zero=np.asarray(zeros))
+        return st.decode(op, dec, query_min, query_max)
+
+
+__all__ = ["RosterEntry", "Roster", "DrynxNode", "RemoteClient"]

@@ -1,0 +1,448 @@
+"""Survey orchestration: CN / DP / VN roles + the in-process cluster harness.
+
+This is the TPU-native counterpart of the reference's service layer
+(services/service.go HandleSurveyQuery :263 / StartService :711,
+service_data_provider.go HandleSurveyQueryToDP :15) plus the onet LocalTest
+in-process multi-node harness the reference uses for every integration test
+(services/service_test.go:29-66).
+
+Phase pipeline per survey (reference StartService order, service.go:711-747):
+
+  DP encode+encrypt  ->  collective aggregation  ->  [obfuscation]
+  -> [DRO noise]     ->  key switch to querier   ->  decrypt + decode
+
+All ciphertext math runs as batched device kernels (drynx_tpu.crypto,
+drynx_tpu.parallel); proofs fire on worker threads to the VNs (the
+reference's async goroutine pipeline, data_collection_protocol.go:279-347)
+while the main phase path continues.
+"""
+from __future__ import annotations
+
+import dataclasses
+import secrets
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import batching as B
+from ..crypto import curve as C
+from ..crypto import elgamal as eg
+from ..crypto import refimpl
+from ..encoding import stats as st
+from ..models import logreg as lr
+from ..parallel import collective as col
+from ..parallel import dro
+from ..proofs import aggregation as agg_proof
+from ..proofs import keyswitch as ks_proof
+from ..proofs import obfuscation as obf_proof
+from ..proofs import range_proof as rproof
+from ..proofs import requests as rq
+from ..proofs import shuffle as shuffle_proof
+from ..utils.timers import PhaseTimers
+from .proof_collection import VerifyingNode, VNGroup
+from .query import (DiffPParams, Operation, Query, SurveyQuery,
+                    check_parameters, choose_operation, query_to_proofs_nbrs)
+
+
+@dataclasses.dataclass
+class NodeIdentity:
+    name: str
+    secret: int
+    public: tuple  # affine int pair
+
+
+def _new_identity(name: str, rng: np.random.Generator) -> NodeIdentity:
+    x, pub = eg.keygen(rng)
+    return NodeIdentity(name=name, secret=x, public=pub)
+
+
+class DataProvider:
+    """DP role: local data -> sufficient statistics -> ciphertexts + proofs
+    (reference GenerateData, data_collection_protocol.go:178-374)."""
+
+    def __init__(self, ident: NodeIdentity, data=None):
+        self.ident = ident
+        self.data = data  # op-dependent host array (or (X, y) for log_reg)
+
+    def local_stats(self, op: Operation, rng) -> np.ndarray:
+        if op.name == "log_reg":
+            X, y = self.data
+            return np.asarray(lr.encode_clear(X, y, op.lr_params))
+        data = self.data
+        if data is None:  # dummy data like createFakeDataForOperation
+            data = rng.integers(op.query_min, max(op.query_max, 1),
+                                size=(32,)).astype(np.int64)
+        return np.asarray(st.encode_clear(
+            op.name, data, op.query_min, op.query_max))
+
+
+class Survey:
+    """Mutable per-survey state on the root CN (reference ServiceDrynx
+    survey map, service.go:82-108)."""
+
+    def __init__(self, sq: SurveyQuery):
+        self.sq = sq
+        self.timers = PhaseTimers()
+        self.proof_threads: list[threading.Thread] = []
+
+
+class LocalCluster:
+    """In-process roster: CNs, DPs (mapped to CNs), VNs + querier.
+
+    The onet LocalTest equivalent — full multi-node semantics, one process
+    (reference services/service_test.go:29-66 generateNodes/repartitionDPs).
+    """
+
+    def __init__(self, n_cns: int = 3, n_dps: int = 5, n_vns: int = 3,
+                 seed: int = 1, dlog_limit: int = 10000):
+        rng = np.random.default_rng(seed)
+        self.rng = rng
+        self.cns = [_new_identity(f"cn{i}", rng) for i in range(n_cns)]
+        self.dp_idents = [_new_identity(f"dp{i}", rng) for i in range(n_dps)]
+        self.vn_idents = [_new_identity(f"vn{i}", rng) for i in range(n_vns)]
+        self.client = _new_identity("client", rng)
+
+        # collective key over the CN roster
+        self.coll_pub = col.collective_key([c.public for c in self.cns])
+        self.coll_tbl = eg.pub_table(self.coll_pub)
+        self.client_tbl = eg.pub_table(self.client.public)
+        self.client_pt = jnp.asarray(C.from_ref(self.client.public))
+        self.dlog = eg.DecryptionTable(limit=dlog_limit)
+
+        # DP -> CN mapping (reference repartitionDPs round robin)
+        self.server_to_dp = {}
+        for i, dp in enumerate(self.dp_idents):
+            cn = self.cns[i % n_cns].name
+            self.server_to_dp.setdefault(cn, []).append(dp.name)
+
+        self.dps: dict[str, DataProvider] = {
+            d.name: DataProvider(d) for d in self.dp_idents}
+
+        pubs = {n.name: n.public
+                for n in self.cns + self.dp_idents + [self.client]}
+        self.vns: Optional[VNGroup] = None
+        if n_vns > 0:
+            import tempfile
+
+            self._vn_dir = tempfile.mkdtemp(prefix="drynx_vn_")
+            self.vns = VNGroup([
+                VerifyingNode(v.name, f"{self._vn_dir}/{v.name}.db", pubs,
+                              verify_fns=self._verify_fns(), seed=i)
+                for i, v in enumerate(self.vn_idents)])
+
+        self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
+        self.surveys: dict[str, Survey] = {}
+
+    # ------------------------------------------------------------------
+    # Proof payload verifiers installed at the VNs
+    # ------------------------------------------------------------------
+    def _verify_fns(self):
+        def vrange(data: bytes) -> bool:
+            pb = rproof.RangeProofBatch.from_bytes(data)
+            sigs = self.range_sigs.get(pb.u)
+            if sigs is None:
+                return False
+            return bool(np.all(rproof.verify_range_proofs(
+                pb, [s.public for s in sigs], self.coll_tbl.table)))
+
+        def vagg(data: bytes) -> bool:
+            import pickle
+
+            proof = pickle.loads(data)
+            return bool(np.all(agg_proof.verify_aggregation_proof(proof)))
+
+        def vobf(data: bytes) -> bool:
+            import pickle
+
+            proof = pickle.loads(data)
+            return bool(np.all(obf_proof.verify_obfuscation_proofs(proof)))
+
+        def vks(data: bytes) -> bool:
+            import pickle
+
+            proof = pickle.loads(data)
+            return bool(np.all(ks_proof.verify_keyswitch_proofs(
+                proof, self.client_tbl.table)))
+
+        def vshuffle(data: bytes) -> bool:
+            import pickle
+
+            proof, in_cts, out_cts = pickle.loads(data)
+            return shuffle_proof.verify_shuffle(
+                proof, jnp.asarray(in_cts), jnp.asarray(out_cts),
+                jnp.asarray(C.from_ref(self.coll_pub)))
+
+        return {"range": vrange, "aggregation": vagg, "obfuscation": vobf,
+                "keyswitch": vks, "shuffle": vshuffle}
+
+    # ------------------------------------------------------------------
+    # Survey query construction (reference API.GenerateSurveyQuery, api.go:58)
+    # ------------------------------------------------------------------
+    def generate_survey_query(self, op_name: str, query_min: int = 0,
+                              query_max: int = 0, dims: int = 1,
+                              proofs: int = 0, obfuscation: bool = False,
+                              ranges=None, diffp: Optional[DiffPParams] = None,
+                              lr_params=None, thresholds: float = 1.0,
+                              cutting_factor: int = 0) -> SurveyQuery:
+        op = choose_operation(op_name, query_min, query_max, dims,
+                              cutting_factor, lr_params)
+        if proofs and ranges is None:
+            # default range: values fit in [0, 16^4)
+            ranges = [(16, 4)] * op.nbr_output
+        q = Query(operation=op, ranges=ranges, proofs=proofs,
+                  obfuscation=obfuscation,
+                  diffp=diffp or DiffPParams(),
+                  dp_data_min=query_min, dp_data_max=query_max,
+                  sigs_present=proofs == 1 and ranges is not None
+                  and not all(u == 0 and l == 0 for (u, l) in ranges))
+        sq = SurveyQuery(
+            survey_id=f"survey-{secrets.token_hex(4)}",
+            query=q,
+            server_ids=[c.name for c in self.cns],
+            server_to_dp=self.server_to_dp,
+            vn_ids=[v.name for v in self.vn_idents] if proofs else [],
+            client_pub=self.client.public,
+            id_to_public={n.name: n.public for n in
+                          self.cns + self.dp_idents + self.vn_idents},
+            threshold=thresholds if proofs else 0.0,
+            aggregation_proof_threshold=thresholds if proofs else 0.0,
+            obfuscation_proof_threshold=(thresholds if proofs and obfuscation
+                                         else 0.0),
+            range_proof_threshold=thresholds if proofs else 0.0,
+            key_switching_proof_threshold=thresholds if proofs else 0.0)
+        ok, msg = check_parameters(sq, q.diffp.enabled())
+        if not ok:
+            raise ValueError(f"invalid survey parameters: {msg}")
+        return sq
+
+    # ------------------------------------------------------------------
+    # Range-proof signature setup (reference InitRangeProofSignature — done
+    # once per (server, base u) at query setup, api.go / simul)
+    # ------------------------------------------------------------------
+    def ensure_range_sigs(self, u: int) -> list[rproof.RangeSig]:
+        if u not in self.range_sigs:
+            self.range_sigs[u] = [rproof.init_range_sig(u, self.rng)
+                                  for _ in self.cns]
+        return self.range_sigs[u]
+
+    # ------------------------------------------------------------------
+    # The full survey (reference SendSurveyQuery path, SURVEY.md §3.1)
+    # ------------------------------------------------------------------
+    def run_survey(self, sq: SurveyQuery, seed: int = 0):
+        survey = Survey(sq)
+        self.surveys[sq.survey_id] = survey
+        q = sq.query
+        op = q.operation
+        tm = survey.timers
+        key = jax.random.PRNGKey(seed)
+        proofs_on = q.proofs == 1 and self.vns is not None
+
+        if proofs_on:
+            nbrs = query_to_proofs_nbrs(sq)
+            expected = sum(nbrs)
+            self.vns.register_survey(
+                sq.survey_id, expected,
+                {"range": sq.range_proof_threshold,
+                 "shuffle": sq.threshold,
+                 "aggregation": sq.aggregation_proof_threshold,
+                 "obfuscation": sq.obfuscation_proof_threshold,
+                 "keyswitch": sq.key_switching_proof_threshold})
+
+        # --- DP phase: encode + encrypt (+ range proofs) ----------------
+        tm.start("DataCollectionProtocol")
+        dp_stats = np.stack([
+            self.dps[d.name].local_stats(op, self.rng)
+            for d in self.dp_idents])                       # (n_dps, V)
+        V = dp_stats.shape[1]
+        key, k_enc = jax.random.split(key)
+        enc_rs = eg.random_scalars(k_enc, dp_stats.shape)
+        m = B.int_to_scalar(jnp.asarray(dp_stats))
+        cts = B.encrypt(eg.BASE_TABLE.table, self.coll_tbl.table,
+                        m, enc_rs)                          # (n_dps, V, 2,3,16)
+        tm.end("DataCollectionProtocol")
+
+        if proofs_on:
+            u, l = q.ranges[0]
+            sigs = self.ensure_range_sigs(u)
+            for i, dp in enumerate(self.dp_idents):
+                key, k_rp = jax.random.split(key)
+                self._async_proof(
+                    survey, "range", dp,
+                    lambda i=i, k_rp=k_rp, u=u, l=l, sigs=sigs:
+                    rproof.create_range_proofs(
+                        k_rp, dp_stats[i], enc_rs[i], cts[i], sigs, u, l,
+                        self.coll_tbl.table).to_bytes())
+
+        # --- Aggregation phase (reference AggregationPhase :775) --------
+        tm.start("AggregationPhase")
+        agg = B.tree_reduce_add(cts, B.ct_add)
+        agg.block_until_ready()
+        tm.end("AggregationPhase")
+        if proofs_on:
+            for cn in self.cns:
+                self._async_proof(
+                    survey, "aggregation", cn,
+                    lambda: _pickle(agg_proof.create_aggregation_proof(
+                        cts, agg)))
+
+        # --- Obfuscation phase (zero/nonzero ops only) ------------------
+        if q.obfuscation:
+            tm.start("ObfuscationPhase")
+            obf_scalars = []
+            work = agg
+            for cn in self.cns:
+                # distinct keys for the secret scalar s and the proof's
+                # blinding w — reusing one key would make w == s and leak s
+                key, k_s, k_w = jax.random.split(key, 3)
+                s = eg.random_scalars(k_s, (V,))
+                if proofs_on:
+                    pr = obf_proof.create_obfuscation_proofs(k_w, work, s)
+                    self._async_proof(survey, "obfuscation", cn,
+                                      lambda pr=pr: _pickle(pr))
+                    work = pr.obf
+                else:
+                    work = B.ct_scalar_mul(work, s)
+                obf_scalars.append(s)
+            agg = work
+            agg.block_until_ready()
+            tm.end("ObfuscationPhase")
+
+        # --- DRO / differential privacy noise phase ---------------------
+        noise_ct = None
+        if q.diffp.enabled():
+            tm.start("DROPhase")
+            d = q.diffp
+            noise = dro.generate_noise_values(
+                d.noise_list_size, d.lap_mean, d.lap_scale, d.quanta,
+                d.scale, d.limit)
+            key, k_n = jax.random.split(key)
+            n_cts = dro.encrypt_noise(k_n, self.coll_tbl, noise)
+            for cn in self.cns:
+                key, k_sh = jax.random.split(key)
+                out_cts, perm, rs = dro.shuffle_rerandomize(
+                    k_sh, n_cts, self.coll_tbl.table)
+                if proofs_on:
+                    betas = [_limbs_to_int(r) for r in np.asarray(rs)]
+                    pr = shuffle_proof.prove_shuffle(
+                        n_cts, out_cts, np.asarray(perm), betas,
+                        jnp.asarray(C.from_ref(self.coll_pub)),
+                        np.random.default_rng(secrets.randbits(128)))
+                    self._async_proof(
+                        survey, "shuffle", cn,
+                        lambda pr=pr, a=np.asarray(n_cts),
+                        b=np.asarray(out_cts): _pickle((pr, a, b)))
+                n_cts = out_cts
+            # one noise ct added per result (service.go:600-604)
+            idx = np.arange(V) % int(n_cts.shape[0])
+            noise_ct = jnp.take(n_cts, jnp.asarray(idx), axis=0)
+            agg = B.ct_add(agg, noise_ct)
+            tm.end("DROPhase")
+
+        # --- Key switch to the querier's key ----------------------------
+        tm.start("KeySwitchingPhase")
+        srv_x = jnp.asarray(np.stack([eg.secret_to_limbs(c.secret)
+                                      for c in self.cns]))
+        key, k_ks = jax.random.split(key)
+        ks_rs = eg.random_scalars(k_ks, (len(self.cns), V))
+        # per-server contributions, batched over (ns, V):
+        # U = r·B,  W = r·Q − x·K   (commuting; sum replaces the CN chain)
+        K0 = agg[:, 0]                                      # (V, 3, 16)
+        u_pts = B.fixed_base_mul(eg.BASE_TABLE.table, ks_rs)
+        rQ = B.fixed_base_mul(self.client_tbl.table, ks_rs)
+        xK = B.g1_scalar_mul(K0[None], srv_x[:, None, :])
+        w_pts = B.g1_add(rQ, B.g1_neg(xK))
+        k_sum, c_sum = u_pts[0], w_pts[0]
+        for i in range(1, len(self.cns)):
+            k_sum = B.g1_add(k_sum, u_pts[i])
+            c_sum = B.g1_add(c_sum, w_pts[i])
+        switched = jnp.stack(
+            [k_sum, B.g1_add(agg[:, 1], c_sum)], axis=-3)
+        switched.block_until_ready()
+        tm.end("KeySwitchingPhase")
+        if proofs_on:
+            key, k_kp = jax.random.split(key)
+            pr = ks_proof.create_keyswitch_proofs(
+                k_kp, agg[:, 0], srv_x, ks_rs, self.client_pt,
+                self.client_tbl.table, u_pts, w_pts)
+            for cn in self.cns:
+                self._async_proof(survey, "keyswitch", cn,
+                                  lambda pr=pr: _pickle(pr))
+
+        # --- Querier decrypt + decode -----------------------------------
+        tm.start("Decryption")
+        xq = jnp.asarray(eg.secret_to_limbs(self.client.secret))
+        pts = B.decrypt_point(switched, xq)
+        dl = self.dlog
+        vals, found = B.table_lookup(dl.keys, dl.xs, dl.ysign, dl.vals, pts)
+        zeros = B.is_infinity(pts)
+        tm.end("Decryption")
+
+        dec = st.DecryptedVector(values=np.asarray(vals),
+                                 found=np.asarray(found),
+                                 is_zero=np.asarray(zeros))
+        if op.name == "log_reg":
+            tm.start("GradientDescent")
+            Ts = lr.unpack(jnp.asarray(dec.values), op.lr_params)
+            w = np.asarray(lr.train(Ts, op.lr_params))
+            tm.end("GradientDescent")
+            result = w
+        else:
+            result = st.decode(op.name, dec, op.query_min, op.query_max,
+                               dims=(op.nbr_input - 1)
+                               if op.name == "lin_reg" else 1)
+
+        # --- VN finalization --------------------------------------------
+        block = None
+        if proofs_on:
+            for t in survey.proof_threads:
+                t.join(timeout=600)
+            block = self.vns.end_verification(sq.survey_id, timeout=600)
+        return SurveyResult(result=result, decrypted=dec, block=block,
+                            timers=tm, survey_id=sq.survey_id)
+
+    # ------------------------------------------------------------------
+    def _async_proof(self, survey: Survey, ptype: str, ident: NodeIdentity,
+                     build) -> None:
+        """Fire-and-track: build proof bytes + deliver to VNs on a thread
+        (the reference's async goroutine pipeline)."""
+
+        def work():
+            data = build()
+            req = rq.new_proof_request(
+                ptype, survey.sq.survey_id, ident.name,
+                f"{ptype}-{ident.name}", 0, data, ident.secret)
+            self.vns.deliver(req)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        survey.proof_threads.append(t)
+
+
+@dataclasses.dataclass
+class SurveyResult:
+    result: object
+    decrypted: st.DecryptedVector
+    block: object
+    timers: PhaseTimers
+    survey_id: str
+
+
+def _pickle(obj) -> bytes:
+    import pickle
+
+    return pickle.dumps(obj)
+
+
+def _limbs_to_int(limbs: np.ndarray) -> int:
+    from ..crypto import params
+
+    v = 0
+    for k in range(limbs.shape[-1] - 1, -1, -1):
+        v = (v << params.LIMB_BITS) | int(limbs[k])
+    return v
+
+
+__all__ = ["NodeIdentity", "DataProvider", "LocalCluster", "SurveyResult"]

@@ -1,0 +1,143 @@
+"""TCP control plane: length-prefixed JSON messages between node processes.
+
+The reference's onet overlay (TCP + registered-message marshaling,
+services/service.go:117-139, SendProtobuf at api.go:110) maps to two planes
+on TPU (SURVEY.md §2.3): the *data plane* (ciphertext math) rides XLA
+collectives inside the device mesh, while the *control plane* (query
+distribution, DP responses from external institutions, proof envelopes) is
+host-side networking — this module. Binary tensors travel as base64 fields
+inside JSON frames; every frame is [u32 length][utf-8 JSON payload].
+"""
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode())
+
+
+def pack_array(a) -> dict:
+    a = np.asarray(a)
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "data": b64(a.tobytes())}
+
+
+def unpack_array(d: dict) -> np.ndarray:
+    return np.frombuffer(unb64(d["data"]),
+                         dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    raw = json.dumps(obj).encode()
+    sock.sendall(len(raw).to_bytes(4, "big") + raw)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    n = int.from_bytes(head, "big")
+    body = _recv_exact(sock, n)
+    return None if body is None else json.loads(body.decode())
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+Handler = Callable[[dict], dict]
+
+
+class NodeServer:
+    """One node process: a request/response dispatcher over TCP.
+
+    The onet service-handler analogue: handlers are registered by message
+    type (reference RegisterHandler via onet, service.go:149-170).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.handlers: dict[str, Handler] = {}
+        outer = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = recv_msg(self.request)
+                    if msg is None:
+                        return
+                    mtype = msg.get("type", "")
+                    fn = outer.handlers.get(mtype)
+                    try:
+                        if fn is None:
+                            raise KeyError(f"no handler for {mtype!r}")
+                        reply = fn(msg)
+                        reply.setdefault("type", mtype + "_reply")
+                    except Exception as e:  # fault is reported, not fatal
+                        reply = {"type": "error", "error": repr(e)}
+                    send_msg(self.request, reply)
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = _Srv((host, port), _H)
+        self.host, self.port = self.server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, mtype: str, fn: Handler) -> None:
+        self.handlers[mtype] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.server.serve_forever()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class Conn:
+    """Client connection with request/response semantics (SendProtobuf)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 900.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._lock = threading.Lock()
+
+    def call(self, obj: dict) -> dict:
+        with self._lock:
+            send_msg(self.sock, obj)
+            reply = recv_msg(self.sock)
+        if reply is None:
+            raise ConnectionError("connection closed by peer")
+        if reply.get("type") == "error":
+            raise RuntimeError(f"remote error: {reply.get('error')}")
+        return reply
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+__all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
+           "recv_msg", "NodeServer", "Conn"]

@@ -1,0 +1,143 @@
+"""Full-system in-process survey tests — the reference's TestServiceDrynx
+pattern (services/service_test.go:70-349): run the complete query pipeline
+over an operation list and assert the decrypted result equals the clear-text
+computation; with proofs on, additionally require every bitmap code to be
+BM_TRUE and the audit block to exist."""
+import numpy as np
+import pytest
+
+from drynx_tpu.encoding import stats as st
+from drynx_tpu.proofs import requests as rq
+from drynx_tpu.service.query import DiffPParams
+from drynx_tpu.service.service import LocalCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # dlog table must cover the largest decrypted value (Σx² for variance)
+    return LocalCluster(n_cns=3, n_dps=4, n_vns=0, seed=3, dlog_limit=25000)
+
+
+def _install_data(cluster, op, rng, rows=24):
+    """Give every DP op-appropriate local data; return per-DP arrays."""
+    per_dp = []
+    for name, dp in cluster.dps.items():
+        if op in ("cosim",):
+            d = rng.integers(0, 10, size=(rows, 2)).astype(np.int64)
+        elif op == "lin_reg":
+            X = rng.integers(0, 5, size=(rows, 2)).astype(np.int64)
+            y = 2 * X[:, 0] + 3 * X[:, 1] + 1
+            d = np.concatenate([X, y[:, None]], axis=1)
+        elif op == "r2":
+            d = rng.integers(0, 8, size=(rows,)).astype(np.int64)
+        elif op in ("bool_OR", "bool_AND"):
+            d = rng.integers(0, 2, size=(rows,)).astype(np.int64)
+        else:
+            d = rng.integers(0, 15, size=(rows,)).astype(np.int64)
+        dp.data = d
+        per_dp.append(d)
+    return per_dp
+
+
+OPS_NO_PROOF = ["sum", "mean", "variance", "frequency_count", "min", "max",
+                "union", "inter", "bool_OR", "bool_AND"]
+
+
+@pytest.mark.parametrize("op", OPS_NO_PROOF)
+def test_survey_matches_cleartext(cluster, op):
+    rng = np.random.default_rng(hash(op) % 2**31)
+    per_dp = _install_data(cluster, op, rng)
+    qmin, qmax = 0, 15
+    sq = cluster.generate_survey_query(op, query_min=qmin, query_max=qmax)
+    res = cluster.run_survey(sq)
+
+    allv = np.concatenate(per_dp)
+    if op == "sum":
+        assert res.result == int(allv.sum())
+    elif op == "mean":
+        assert res.result == pytest.approx(float(allv.mean()))
+    elif op == "variance":
+        assert res.result == pytest.approx(float(allv.var()), rel=1e-9)
+    elif op == "frequency_count":
+        want = {v: int((allv == v).sum()) for v in range(qmin, qmax + 1)}
+        assert res.result == want
+    elif op == "min":
+        assert res.result == int(allv.min())
+    elif op == "max":
+        assert res.result == int(allv.max())
+    elif op == "union":
+        assert sorted(res.result) == sorted(set(allv.tolist()))
+    elif op == "inter":
+        inter = set(per_dp[0].tolist())
+        for d in per_dp[1:]:
+            inter &= set(d.tolist())
+        assert sorted(res.result) == sorted(inter)
+    elif op == "bool_OR":
+        assert res.result == bool(np.any(allv != 0))
+    elif op == "bool_AND":
+        assert res.result == bool(np.all(
+            [np.all(d != 0) for d in per_dp]))
+
+
+def test_survey_cosim_and_linreg_and_r2(cluster):
+    rng = np.random.default_rng(77)
+    per_dp = _install_data(cluster, "cosim", rng)
+    sq = cluster.generate_survey_query("cosim")
+    res = cluster.run_survey(sq)
+    allv = np.concatenate(per_dp)
+    a, b = allv[:, 0].astype(float), allv[:, 1].astype(float)
+    want = float((a * b).sum() / (np.sqrt((a * a).sum()) * np.sqrt((b * b).sum())))
+    assert res.result == pytest.approx(want, rel=1e-9)
+
+    per_dp = _install_data(cluster, "lin_reg", rng)
+    sq = cluster.generate_survey_query("lin_reg", dims=2)
+    res = cluster.run_survey(sq)
+    # y = 1 + 2 x0 + 3 x1 exactly -> coefficients recovered exactly
+    assert np.allclose(res.result, [1.0, 2.0, 3.0], atol=1e-8)
+
+
+def test_survey_obfuscation_preserves_zeroness(cluster):
+    rng = np.random.default_rng(5)
+    _install_data(cluster, "union", rng)
+    sq = cluster.generate_survey_query("union", query_min=0, query_max=15,
+                                       obfuscation=True)
+    res_plain = cluster.run_survey(
+        cluster.generate_survey_query("union", query_min=0, query_max=15))
+    res_obf = cluster.run_survey(sq)
+    assert sorted(res_obf.result) == sorted(res_plain.result)
+
+
+def test_survey_diffp_adds_noise(cluster):
+    rng = np.random.default_rng(6)
+    per_dp = _install_data(cluster, "sum", rng)
+    diffp = DiffPParams(noise_list_size=16, lap_mean=0.0, lap_scale=2.0,
+                        quanta=1.0, scale=1.0, limit=8.0)
+    sq = cluster.generate_survey_query("sum", query_min=0, query_max=15,
+                                       diffp=diffp)
+    res = cluster.run_survey(sq)
+    clear = int(np.concatenate(per_dp).sum())
+    # noise list values are bounded by limit*scale
+    assert abs(res.result - clear) <= 8
+
+
+@pytest.fixture(scope="module")
+def cluster_proofs():
+    return LocalCluster(n_cns=2, n_dps=2, n_vns=2, seed=11, dlog_limit=4000)
+
+
+def test_survey_with_proofs_commits_clean_bitmap(cluster_proofs):
+    cl = cluster_proofs
+    rng = np.random.default_rng(8)
+    per_dp = []
+    for dp in cl.dps.values():
+        d = rng.integers(0, 10, size=(16,)).astype(np.int64)
+        dp.data = d
+        per_dp.append(d)
+    sq = cl.generate_survey_query("sum", query_min=0, query_max=15, proofs=1,
+                                  ranges=[(4, 4)])  # sums < 256
+    res = cl.run_survey(sq)
+    assert res.result == int(np.concatenate(per_dp).sum())
+    assert res.block is not None
+    codes = set(res.block.data.bitmap.values())
+    assert codes == {rq.BM_TRUE}, res.block.data.bitmap
+    assert cl.vns.root.chain.validate()

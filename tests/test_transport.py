@@ -1,0 +1,55 @@
+"""TCP control plane + multi-node (in-process threads, real sockets) survey.
+
+The real-TCP analogue of the reference's shell e2e tier (test/lib.sh boots
+N server processes; client_run-survey drives a survey through them)."""
+import numpy as np
+import pytest
+
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.service.node import DrynxNode, RemoteClient, Roster, RosterEntry
+from drynx_tpu.service.transport import Conn, NodeServer, pack_array, unpack_array
+
+
+def test_transport_roundtrip():
+    srv = NodeServer()
+    srv.register("echo", lambda m: {"payload": m["payload"]})
+    srv.start()
+    c = Conn(srv.host, srv.port)
+    assert c.call({"type": "echo", "payload": [1, 2, 3]})["payload"] == [1, 2, 3]
+    with pytest.raises(RuntimeError):
+        c.call({"type": "nope"})
+    arr = np.arange(12, dtype=np.uint32).reshape(3, 4)
+    packed = pack_array(arr)
+    assert np.array_equal(unpack_array(packed), arr)
+    c.close()
+    srv.stop()
+
+
+def test_remote_survey_sum(tmp_path):
+    rng = np.random.default_rng(21)
+    nodes = []
+    entries = []
+    datas = []
+    for i, role in enumerate(["cn", "cn", "dp", "dp", "vn"]):
+        x, pub = eg.keygen(rng)
+        data = None
+        if role == "dp":
+            data = rng.integers(0, 10, size=(8,)).astype(np.int64)
+            datas.append(data)
+        n = DrynxNode(f"{role}{i}", x, pub, data=data,
+                      db_path=str(tmp_path / f"{role}{i}.db"))
+        n.start()
+        entries.append(RosterEntry(name=f"{role}{i}", role=role,
+                                   host=n.address[0], port=n.address[1],
+                                   public=pub))
+        nodes.append(n)
+
+    roster = Roster(entries)
+    client = RemoteClient(roster, rng)
+    client.broadcast_roster()
+    result = client.run_survey("sum", query_min=0, query_max=9,
+                               dlog=eg.DecryptionTable(limit=500))
+    want = int(sum(d.sum() for d in datas))
+    assert result == want
+    for n in nodes:
+        n.stop()
