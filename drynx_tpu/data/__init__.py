@@ -1,0 +1,6 @@
+"""Datasets + test-data generation (reference data/ directory)."""
+from .generator import (  # noqa: F401
+    create_random_good_test_data,
+    synthetic_classification_csv,
+    load_label_csv,
+)

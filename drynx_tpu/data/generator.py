@@ -1,0 +1,118 @@
+"""Test-data generation: valid instances of all five proof types + dataset
+synthesis/loading.
+
+`create_random_good_test_data` mirrors reference data/data.go:27-107 (used by
+proof-collection tests and simulations to exercise VN verification without a
+real survey). Dataset helpers produce/load CSVs in the reference's
+label-first format (lib/encoding/logistic_regression.go:1275 LoadData).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def create_random_good_test_data(cluster, n_values: int = 2, u: int = 4,
+                                 l: int = 2, seed: int = 0) -> dict:
+    """Build one valid proof of each type against `cluster`'s keys.
+
+    Returns {"range": bytes, "aggregation": bytes, "obfuscation": bytes,
+    "keyswitch": bytes, "shuffle": bytes} — each ready for a ProofRequest.
+    """
+    import jax
+    import jax.numpy as jnp
+    import pickle
+
+    from ..crypto import curve as C
+    from ..crypto import elgamal as eg
+    from ..proofs import aggregation as agg_proof
+    from ..proofs import keyswitch as ks_proof
+    from ..proofs import obfuscation as obf_proof
+    from ..proofs import range_proof as rproof
+    from ..proofs import shuffle as shuffle_proof
+
+    rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed)
+    out = {}
+
+    # range
+    sigs = cluster.ensure_range_sigs(u)
+    vals = rng.integers(0, u ** l, size=(n_values,)).astype(np.int64)
+    key, k1, k2 = jax.random.split(key, 3)
+    cts, rs = eg.encrypt_ints(k1, cluster.coll_tbl, vals)
+    out["range"] = rproof.create_range_proofs(
+        k2, vals, rs, cts, sigs, u, l, cluster.coll_tbl.table).to_bytes()
+
+    # aggregation
+    key, k3 = jax.random.split(key)
+    many, _ = eg.encrypt_ints(k3, cluster.coll_tbl,
+                              rng.integers(0, 9, size=(3, n_values)))
+    agg = eg.ct_add(eg.ct_add(many[0], many[1]), many[2])
+    out["aggregation"] = pickle.dumps(
+        agg_proof.create_aggregation_proof(many, agg))
+
+    # obfuscation
+    key, k4, k5 = jax.random.split(key, 3)
+    s = eg.random_scalars(k4, (n_values,))
+    out["obfuscation"] = pickle.dumps(
+        obf_proof.create_obfuscation_proofs(k5, cts, s))
+
+    # keyswitch
+    key, k6, k7 = jax.random.split(key, 3)
+    srv_x = jnp.asarray(np.stack([eg.secret_to_limbs(c.secret)
+                                  for c in cluster.cns]))
+    ks_rs = eg.random_scalars(k6, (len(cluster.cns), n_values))
+    from ..crypto import batching as B
+
+    K0 = cts[:, 0]
+    u_pts = B.fixed_base_mul(eg.BASE_TABLE.table, ks_rs)
+    rQ = B.fixed_base_mul(cluster.client_tbl.table, ks_rs)
+    xK = B.g1_scalar_mul(K0[None], srv_x[:, None, :])
+    w_pts = B.g1_add(rQ, B.g1_neg(xK))
+    out["keyswitch"] = pickle.dumps(ks_proof.create_keyswitch_proofs(
+        k7, K0, srv_x, ks_rs, cluster.client_pt, cluster.client_tbl.table,
+        u_pts, w_pts))
+
+    # shuffle
+    perm = rng.permutation(n_values)
+    betas = [int(rng.integers(1, 1 << 62)) for _ in range(n_values)]
+    shuffled = jnp.take(cts, jnp.asarray(perm), axis=0)
+    from ..crypto import field as F
+
+    rs2 = jnp.asarray(np.stack([F.from_int(b) for b in betas]))
+    zero_ct = eg.encrypt_with_tables(
+        eg.BASE_TABLE.table, cluster.coll_tbl.table,
+        eg.int_to_scalar(jnp.zeros((n_values,), dtype=jnp.int64)), rs2)
+    out_cts = eg.ct_add(shuffled, zero_ct)
+    pr = shuffle_proof.prove_shuffle(
+        cts, out_cts, perm, betas,
+        jnp.asarray(C.from_ref(cluster.coll_pub)), rng)
+    out["shuffle"] = pickle.dumps((pr, np.asarray(cts), np.asarray(out_cts)))
+
+    return out
+
+
+def synthetic_classification_csv(path: str, n: int = 200, d: int = 8,
+                                 seed: int = 0, sep: str = ",") -> None:
+    """Write a label-first CSV shaped like the reference's Pima-format data
+    files (label column first, integer-ish features)."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(d,))
+    X = rng.normal(loc=4.0, scale=2.0, size=(n, d))
+    logits = (X - X.mean(0)) @ w
+    y = (logits + rng.logistic(size=n) > 0).astype(int)
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(sep.join([str(y[i])] + [f"{v:.3f}" for v in X[i]]) + "\n")
+
+
+def load_label_csv(path: str, label_column: int = 0, sep: str = ","):
+    """Load (X, y) from a label CSV (reference LoadData,
+    logistic_regression.go:1275)."""
+    raw = np.loadtxt(path, delimiter=sep, ndmin=2)
+    y = raw[:, label_column].astype(np.int64)
+    X = np.delete(raw, label_column, axis=1)
+    return X, y
+
+
+__all__ = ["create_random_good_test_data", "synthetic_classification_csv",
+           "load_label_csv"]

@@ -1,0 +1,125 @@
+"""TOML-grid simulation runner (reference simul/drynx_simul.go:28-305).
+
+Grid semantics follow onet simulation runfiles: top-level keys are shared
+defaults, each [[run]] table overrides them for one run. Output: a list of
+result dicts + a CSV string whose columns are the phase taxonomy
+(SURVEY.md §5) — written next to the runfile when invoked via run_file.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import time
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SimulationConfig:
+    """One grid row (reference SimulationDrynx fields, drynx_simul.go:28-80)."""
+
+    nbr_servers: int = 3
+    nbr_dps: int = 5
+    nbr_vns: int = 0
+    operation: str = "sum"
+    proofs: int = 0
+    query_min: int = 0
+    query_max: int = 15
+    rows_per_dp: int = 32
+    ranges_u: int = 4
+    ranges_l: int = 4
+    diffp_size: int = 0
+    diffp_scale: float = 0.0
+    dlog_limit: int = 25000
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimulationConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k.lower(): v for k, v in d.items()
+                      if k.lower() in known})
+
+
+def run_simulation(cfg: SimulationConfig) -> dict:
+    """Run one configuration end to end; returns result + phase timings."""
+    from ..service.api import DrynxClient
+    from ..service.query import DiffPParams
+    from ..service.service import LocalCluster
+
+    rng = np.random.default_rng(cfg.seed)
+    cluster = LocalCluster(n_cns=cfg.nbr_servers, n_dps=cfg.nbr_dps,
+                           n_vns=cfg.nbr_vns if cfg.proofs else 0,
+                           seed=cfg.seed, dlog_limit=cfg.dlog_limit)
+    for dp in cluster.dps.values():
+        dp.data = rng.integers(cfg.query_min, max(cfg.query_max, 1),
+                               size=(cfg.rows_per_dp,)).astype(np.int64)
+
+    client = DrynxClient(cluster)
+    diffp = (DiffPParams(noise_list_size=cfg.diffp_size, lap_mean=0.0,
+                         lap_scale=cfg.diffp_scale, quanta=1.0,
+                         scale=1.0, limit=8.0)
+             if cfg.diffp_size else None)
+    sq = client.generate_survey_query(
+        cfg.operation, query_min=cfg.query_min, query_max=cfg.query_max,
+        proofs=cfg.proofs, diffp=diffp,
+        ranges=[(cfg.ranges_u, cfg.ranges_l)] *
+        sq_out_size(cfg) if cfg.proofs else None)
+
+    t0 = time.perf_counter()
+    res = client.send_survey_query(sq, seed=cfg.seed)
+    total = time.perf_counter() - t0
+
+    timings = dict(res.timers.items())
+    timings["JustExecution"] = total
+    return {"config": dataclasses.asdict(cfg), "result": res.result,
+            "timings": timings,
+            "block_hash": res.block.hash() if res.block else None}
+
+
+def sq_out_size(cfg: SimulationConfig) -> int:
+    from ..encoding import output_size
+
+    return output_size(cfg.operation, cfg.query_min, cfg.query_max)
+
+
+def run_file(path: str, csv_out: Optional[str] = None) -> list[dict]:
+    """Run every [[run]] row of a TOML grid file (reference runfiles)."""
+    from ..cmd import toml_io
+
+    with open(path) as f:
+        cfg = toml_io.loads(f.read())
+    defaults = {k: v for k, v in cfg.items() if not isinstance(v, list)}
+    runs = cfg.get("run", []) or [{}]
+
+    results = []
+    for row in runs:
+        merged = {**defaults, **row}
+        results.append(run_simulation(SimulationConfig.from_dict(merged)))
+
+    if csv_out:
+        with open(csv_out, "w") as f:
+            f.write(results_csv(results))
+    return results
+
+
+def results_csv(results: list[dict]) -> str:
+    """One CSV row per run; columns = union of phase names (the reference's
+    simulation CSV format consumed by parse_time_data_test.go:12-26)."""
+    cols: list[str] = []
+    for r in results:
+        for k in r["timings"]:
+            if k not in cols:
+                cols.append(k)
+    buf = io.StringIO()
+    buf.write(",".join(["operation", "servers", "dps", "vns"] + cols) + "\n")
+    for r in results:
+        c = r["config"]
+        row = [c["operation"], str(c["nbr_servers"]), str(c["nbr_dps"]),
+               str(c["nbr_vns"])]
+        row += [f"{r['timings'].get(k, 0.0):.6f}" for k in cols]
+        buf.write(",".join(row) + "\n")
+    return buf.getvalue()
+
+
+__all__ = ["SimulationConfig", "run_simulation", "run_file", "results_csv"]

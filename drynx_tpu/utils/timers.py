@@ -1,0 +1,70 @@
+"""Phase-level wall-clock timers -> CSV, the reference's observability spine.
+
+Mirrors unlynx StartTimer/EndTimer keyed by "<serverID>_<Phase>" (used at
+reference services/service.go:381,412,717-744 and across lib/proof), whose
+CSV output feeds simul/test_data/parse_time_data_test.go. The phase taxonomy
+(SURVEY.md §5) is preserved so benchmark output stays comparable:
+DataCollectionProtocol, AggregationPhase, KeySwitchingPhase, DPencoding,
+VerifyRange, VerifyAggregation, VerifyKeySwitch, GradientDescent, Decryption,
+AllProofs, JustExecution.
+"""
+from __future__ import annotations
+
+import io
+import threading
+import time
+
+
+class PhaseTimers:
+    """Thread-safe named wall-clock timers accumulating per-phase seconds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._open: dict[str, float] = {}
+        self._acc: dict[str, float] = {}
+
+    def start(self, name: str) -> None:
+        with self._lock:
+            self._open[name] = time.perf_counter()
+
+    def end(self, name: str) -> float:
+        now = time.perf_counter()
+        with self._lock:
+            t0 = self._open.pop(name, None)
+            if t0 is None:
+                return 0.0
+            dt = now - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            return dt
+
+    def __getitem__(self, name: str) -> float:
+        return self._acc.get(name, 0.0)
+
+    def items(self):
+        return sorted(self._acc.items())
+
+    def csv(self) -> str:
+        """Two-row CSV (header + values), the simulation output format."""
+        buf = io.StringIO()
+        keys = [k for k, _ in self.items()]
+        buf.write(",".join(keys) + "\n")
+        buf.write(",".join(f"{self._acc[k]:.6f}" for k in keys) + "\n")
+        return buf.getvalue()
+
+
+GLOBAL = PhaseTimers()
+
+
+def start_timer(name: str) -> None:
+    GLOBAL.start(name)
+
+
+def end_timer(name: str) -> float:
+    return GLOBAL.end(name)
+
+
+def timers_csv() -> str:
+    return GLOBAL.csv()
+
+
+__all__ = ["PhaseTimers", "GLOBAL", "start_timer", "end_timer", "timers_csv"]

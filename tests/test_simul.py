@@ -1,0 +1,20 @@
+"""Simulation harness: grid rows run end-to-end and emit the phase CSV."""
+import os
+
+import numpy as np
+
+from drynx_tpu.simul import SimulationConfig, run_simulation
+from drynx_tpu.simul.runner import results_csv
+
+
+def test_simulation_single_run():
+    cfg = SimulationConfig(nbr_servers=2, nbr_dps=3, operation="mean",
+                           rows_per_dp=8, dlog_limit=2000, seed=4)
+    out = run_simulation(cfg)
+    assert isinstance(out["result"], float)
+    assert out["timings"]["JustExecution"] > 0
+    assert "AggregationPhase" in out["timings"]
+
+    csv = results_csv([out])
+    lines = csv.strip().split("\n")
+    assert len(lines) == 2 and lines[0].startswith("operation,")
