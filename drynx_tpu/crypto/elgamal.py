@@ -209,9 +209,8 @@ def ct_scalar_mul(ct, s_limbs):
     return C.scalar_mul(ct, s_limbs[..., None, :])
 
 
-@jax.jit
 def ct_zero(batch_shape=()):
-    return C.infinity(batch_shape + (2,))
+    return C.infinity(tuple(batch_shape) + (2,))
 
 
 @jax.jit

@@ -105,14 +105,4 @@ def synthetic_classification_csv(path: str, n: int = 200, d: int = 8,
             f.write(sep.join([str(y[i])] + [f"{v:.3f}" for v in X[i]]) + "\n")
 
 
-def load_label_csv(path: str, label_column: int = 0, sep: str = ","):
-    """Load (X, y) from a label CSV (reference LoadData,
-    logistic_regression.go:1275)."""
-    raw = np.loadtxt(path, delimiter=sep, ndmin=2)
-    y = raw[:, label_column].astype(np.int64)
-    X = np.delete(raw, label_column, axis=1)
-    return X, y
-
-
-__all__ = ["create_random_good_test_data", "synthetic_classification_csv",
-           "load_label_csv"]
+__all__ = ["create_random_good_test_data", "synthetic_classification_csv"]

@@ -203,6 +203,64 @@ def predict(X, w, means=None, std_devs=None, threshold=0.5):
     return (predict_probs(X, w, means, std_devs) >= threshold).astype(jnp.int64)
 
 
+def fold_affine(w, means=None, std_devs=None):
+    """Fold standardisation into the weight vector so it acts on RAW records:
+    w·[(x−mu)/sd] + w0  ==  w'·x + w0'. Returns (w0', w' (d,))."""
+    w = np.asarray(w, dtype=np.float64)
+    w0, wf = float(w[0]), w[1:]
+    if means is None:
+        return w0, wf
+    mu = np.asarray(means, dtype=np.float64)
+    sd = np.asarray(std_devs, dtype=np.float64)
+    return w0 - float(np.sum(wf * mu / sd)), wf / sd
+
+
+def predict_homomorphic_ct(cts, w, means=None, std_devs=None,
+                           precision=100.0):
+    """Encrypted margin ciphertext from per-feature ciphertexts of a RAW
+    record (reference PredictHomomorphic, logistic_regression.go:869-899).
+
+    cts: (..., d, 2, 3, 16) — one ciphertext per raw feature value.
+    Clear weights are folded with the standardisation and fixed-point scaled;
+    the margin is Σ_j round(P·w'_j)·ct_j + Enc₀(round(P·w0')), i.e. scalar
+    mults + homomorphic adds only. Decrypt with a dlog table and divide by
+    `precision` to recover ≈ w·x_std + w0.
+    """
+    from ..crypto import elgamal as eg
+    from ..crypto import curve as Cv
+
+    w0p, wp = fold_affine(w, means, std_devs)
+    w_int = jnp.asarray(np.round(np.asarray(wp) * precision), jnp.int64)
+    s = eg.int_to_scalar(w_int)                # (d, 16)
+    terms = eg.ct_scalar_mul(cts, s)           # negative-safe mod n
+
+    def body(acc, t):
+        return eg.ct_add(acc, t), None
+
+    acc0 = eg.ct_zero(cts.shape[:-4])
+    margin, _ = jax.lax.scan(body, acc0, jnp.moveaxis(terms, -4, 0))
+
+    # + Enc₀(w0'): add w0'·B to the C component only (K unchanged).
+    w0_int = jnp.asarray(round(w0p * precision), jnp.int64)
+    w0B = eg.fixed_base_mul(eg.BASE_TABLE.table, eg.int_to_scalar(w0_int))
+    K, Cc = margin[..., 0, :, :], margin[..., 1, :, :]
+    return jnp.stack([K, Cv.add(Cc, w0B)], axis=-3)
+
+
+def predict_homomorphic(cts, w, secret: int, table, means=None,
+                        std_devs=None, precision=100.0, threshold=0.5):
+    """Full homomorphic prediction: encrypted raw records ->
+    (probs, preds, found). `table` must cover the fixed-point margin range
+    (|P·(w·x+w0)|); entries with found=False had no dlog-table hit and
+    their probs are garbage — callers must check."""
+    from ..crypto import elgamal as eg
+
+    mct = predict_homomorphic_ct(cts, w, means, std_devs, precision)
+    margin_int, found = eg.decrypt_ints(mct, secret, table)
+    probs = sigmoid(jnp.asarray(margin_int, jnp.float64) / precision)
+    return probs, (probs >= threshold).astype(jnp.int64), found
+
+
 def accuracy(pred, actual):
     pred, actual = np.asarray(pred), np.asarray(actual)
     return float(np.mean(pred == actual))
@@ -246,7 +304,7 @@ def auc(probs, actual):
 
 def load_csv(path, label_column=0, sep=","):
     """CSV -> (X float64 (n, d), y int64 (n,))."""
-    raw = np.loadtxt(path, delimiter=sep)
+    raw = np.loadtxt(path, delimiter=sep, ndmin=2)
     y = raw[:, label_column].astype(np.int64)
     X = np.delete(raw, label_column, axis=1)
     return X, y
@@ -276,6 +334,7 @@ __all__ = [
     "standardise", "normalize", "augment", "approx_tensors", "encode_clear",
     "unpack", "cost", "closed_form_k1", "train", "train_jit",
     "sigmoid", "predict_probs", "predict",
+    "fold_affine", "predict_homomorphic_ct", "predict_homomorphic",
     "accuracy", "precision", "recall", "f_score", "auc",
     "load_csv", "shard_for_dp", "synthetic_dataset",
 ]

@@ -3,7 +3,8 @@
 Grid semantics follow onet simulation runfiles: top-level keys are shared
 defaults, each [[run]] table overrides them for one run. Output: a list of
 result dicts + a CSV string whose columns are the phase taxonomy
-(SURVEY.md §5) — written next to the runfile when invoked via run_file.
+(SURVEY.md §5); run_file writes it next to the runfile (<name>.timedata.csv)
+unless csv_out overrides the path.
 """
 from __future__ import annotations
 
@@ -34,11 +35,26 @@ class SimulationConfig:
     dlog_limit: int = 25000
     seed: int = 0
 
+    # reference runfile spellings (drynx_simul.go:28-80) -> our field names
+    _ALIASES = {
+        "nbrservers": "nbr_servers", "nbrdps": "nbr_dps",
+        "nbrvns": "nbr_vns", "nbrrows": "rows_per_dp",
+        "rangesu": "ranges_u", "rangesl": "ranges_l",
+        "diffpsize": "diffp_size", "diffpscale": "diffp_scale",
+    }
+
     @classmethod
     def from_dict(cls, d: dict) -> "SimulationConfig":
         known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k.lower(): v for k, v in d.items()
-                      if k.lower() in known})
+        out = {}
+        for k, v in d.items():
+            name = k.lower()
+            name = cls._ALIASES.get(name.replace("_", ""), name)
+            if name not in known:
+                raise ValueError(f"unknown simulation key {k!r} "
+                                 f"(known: {sorted(known)})")
+            out[name] = v
+        return cls(**out)
 
 
 def run_simulation(cfg: SimulationConfig) -> dict:
@@ -97,9 +113,11 @@ def run_file(path: str, csv_out: Optional[str] = None) -> list[dict]:
         merged = {**defaults, **row}
         results.append(run_simulation(SimulationConfig.from_dict(merged)))
 
-    if csv_out:
-        with open(csv_out, "w") as f:
-            f.write(results_csv(results))
+    if csv_out is None:
+        base = path[:-len(".toml")] if path.endswith(".toml") else path
+        csv_out = base + ".timedata.csv"
+    with open(csv_out, "w") as f:
+        f.write(results_csv(results))
     return results
 
 

@@ -1,0 +1,37 @@
+"""CLI e2e discovery runner (reference cmd/cmds_test.go:38-63: find
+executables under test/ and run each with the built binaries on PATH).
+
+The only tier with real processes + real TCP."""
+import os
+import pathlib
+import stat
+import subprocess
+
+import pytest
+
+TEST_DIR = pathlib.Path(__file__).resolve().parent.parent / "test"
+
+
+def _scripts():
+    if not TEST_DIR.is_dir():
+        return []
+    out = []
+    for p in sorted(TEST_DIR.iterdir()):
+        if p.name == "lib.sh" or p.is_dir():
+            continue
+        out.append(p)
+    return out
+
+
+@pytest.mark.parametrize("script", _scripts(), ids=lambda p: p.name)
+def test_shell_e2e(script):
+    st = script.stat()
+    if not st.st_mode & stat.S_IXUSR:
+        script.chmod(st.st_mode | stat.S_IXUSR)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # scripts pin their own platform config
+    r = subprocess.run(["bash", str(script)], capture_output=True, text=True,
+                       timeout=900, env=env)
+    assert r.returncode == 0, (
+        f"{script.name} failed\nstdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+    assert "OK" in r.stdout

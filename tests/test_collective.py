@@ -77,8 +77,10 @@ def test_obfuscation_preserves_zero_semantics(setup):
     out = f(cts, scalars)
 
     xsum = sum(s["secrets"])  # decrypt under collective secret
+    # out_specs=P("srv") concatenates each device's (2, ...) ct block along
+    # axis 0; device 0's block is out[:2].
     z = eg.decrypt_check_zero(
-        out[0], jnp.asarray(eg.secret_to_limbs(xsum)))
+        out[:2], jnp.asarray(eg.secret_to_limbs(xsum)))
     assert np.asarray(z).tolist() == [True, False]
 
 

@@ -1,0 +1,71 @@
+"""Dataset generation/cleaning + time-data post-processing tests
+(SURVEY.md §2.1 #29-30; reference clean_data.py + parse_time_data_test.go)."""
+import subprocess
+import sys
+
+import numpy as np
+
+from drynx_tpu.data import datasets as ds
+from drynx_tpu.models import logreg as lr
+from drynx_tpu.simul import timedata as td
+from drynx_tpu.utils import timers
+
+
+def test_generate_shapes_and_signal():
+    for name, spec in ds.SHAPES.items():
+        X, y = ds.generate(name, seed=1)
+        assert X.shape == (spec["n"], spec["d"])
+        frac = float(y.mean())
+        assert abs(frac - spec["pos_frac"]) < 0.12, (name, frac)
+
+
+def test_csv_roundtrip_and_shard(tmp_path):
+    X, y = ds.generate("pima", seed=2)
+    path = tmp_path / "pima.csv"
+    ds.write_csv(str(path), X, y)
+    X2, y2 = lr.load_csv(str(path), label_column=0)
+    np.testing.assert_allclose(X2, X)
+    np.testing.assert_array_equal(y2, y)
+    Xs, ys = lr.shard_for_dp(X2, y2, 3, 10)
+    assert len(ys) == sum(1 for i in range(len(y)) if i % 10 == 3)
+
+
+def test_clean_drops_sentinels_and_binarizes():
+    X = np.asarray([[1.0, 2.0], [np.nan, 1.0], [-9.0, 3.0], [4.0, 5.0]])
+    y = np.asarray([2, 2, 4, 4])
+    Xc, yc = ds.clean(X, y, missing_sentinels=(-9,), label_true=4)
+    np.testing.assert_allclose(Xc, [[1.0, 2.0], [4.0, 5.0]])
+    np.testing.assert_array_equal(yc, [0, 1])
+
+
+def test_datasets_cli(tmp_path):
+    out = tmp_path / "spectf.csv"
+    r = subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.data.datasets", "gen",
+         "--name", "spectf", "--out", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    X, y = lr.load_csv(str(out))
+    assert X.shape == (267, 44)
+
+
+def test_timedata_parse_and_aggregate():
+    t = timers.PhaseTimers()
+    t.start("srv0_AggregationPhase")
+    t.end("srv0_AggregationPhase")
+    t.start("GradientDescent")
+    t.end("GradientDescent")
+    runs = [td.parse_time_csv(t.csv()) for _ in range(2)]
+    assert "AggregationPhase" in runs[0] and "GradientDescent" in runs[0]
+    agg = td.aggregate(runs)
+    assert set(agg) >= {"AggregationPhase", "GradientDescent"}
+    md = td.render(agg, "md")
+    assert "| AggregationPhase |" in md
+    csv = td.render(agg, "csv")
+    assert csv.startswith("phase,mean_s,best_s")
+
+
+def test_timedata_server_fold_is_max():
+    text = "a_VerifyRange,b_VerifyRange\n1.5,2.5\n"
+    parsed = td.parse_time_csv(text)
+    assert parsed["VerifyRange"] == 2.5

@@ -121,6 +121,31 @@ def test_metrics():
     assert 0.5 <= lr.auc(probs, act) <= 1.0
 
 
+def test_predict_homomorphic_matches_clear():
+    """Encrypted-record prediction (reference PredictHomomorphic,
+    logistic_regression.go:869-899): probs from encrypted raw features must
+    match the clear pipeline up to fixed-point rounding."""
+    d, n = 3, 6
+    X = RNG.integers(0, 8, size=(n, d)).astype(np.float64)
+    means = tuple(np.mean(X, 0))
+    stds = tuple(np.std(X, 0) + 1e-9)
+    w = RNG.normal(size=d + 1)
+
+    x_sec, pub = eg.keygen(RNG)
+    ptab = eg.pub_table(pub)
+    table = eg.DecryptionTable(limit=5000)
+
+    cts, _ = eg.encrypt_ints(jax.random.PRNGKey(3), ptab,
+                             X.astype(np.int64))  # (n, d, 2, 3, 16)
+    probs, preds, found = lr.predict_homomorphic(
+        cts, w, x_sec, table, means=means, std_devs=stds, precision=100.0)
+    assert bool(np.all(np.asarray(found)))
+
+    want = np.asarray(lr.predict_probs(X, jnp.asarray(w), means, stds))
+    np.testing.assert_allclose(np.asarray(probs), want, atol=0.02)
+    assert lr.accuracy(preds, want >= 0.5) == 1.0
+
+
 def test_auc_perfect_classifier():
     probs = np.asarray([0.9, 0.8, 0.2, 0.1])
     act = np.asarray([1, 1, 0, 0])

@@ -1,8 +1,4 @@
 """Simulation harness: grid rows run end-to-end and emit the phase CSV."""
-import os
-
-import numpy as np
-
 from drynx_tpu.simul import SimulationConfig, run_simulation
 from drynx_tpu.simul.runner import results_csv
 
