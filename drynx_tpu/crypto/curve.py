@@ -152,14 +152,38 @@ def neg(p):
     return p.at[..., 1, :].set(Y)
 
 
-@jax.jit
 def scalar_mul(p, k_limbs):
     """k * P. k_limbs: (..., 16) plain (non-Montgomery) scalar limbs.
 
-    256-step double-and-add-always scan; replaces kyber Point.Mul at e.g.
-    reference lib/range/range_proof.go:326 and ElGamal encryption sites.
+    Dispatches to the Pallas ladder kernel on TPU (whole windowed ladder in
+    one kernel, limbs on sublanes / batch on lanes — crypto/pallas_ops.py);
+    elsewhere, the compact 256-step jnp ladder below (see its docstring for
+    why the fallback is deliberately NOT windowed). Replaces kyber Point.Mul
+    at e.g. reference lib/range/range_proof.go:326 and the ElGamal
+    key-switch/decrypt sites.
     """
-    bits = (k_limbs[..., :, None] >> jnp.arange(params.LIMB_BITS, dtype=jnp.uint32)) & 1
+    from . import pallas_ops as po
+
+    if po.available():
+        batch = jnp.broadcast_shapes(p.shape[:-2], k_limbs.shape[:-1])
+        pb = jnp.broadcast_to(p, batch + (3, NUM_LIMBS))
+        kb = jnp.broadcast_to(k_limbs, batch + (NUM_LIMBS,))
+        out = po.scalar_mul_flat(pb.reshape((-1, 3, NUM_LIMBS)),
+                                 kb.reshape((-1, NUM_LIMBS)))
+        return out.reshape(batch + (3, NUM_LIMBS))
+    return _scalar_mul_jnp(p, k_limbs)
+
+
+@jax.jit
+def _scalar_mul_jnp(p, k_limbs):
+    """Fallback ladder: 256-step double-and-add-always scan (constant shape,
+    constant time). Deliberately the COMPACT graph, not the windowed ladder:
+    this path only runs where Pallas doesn't (CPU tests), and XLA's CPU
+    pipeline both compiles the windowed ladder's 16-entry table graph for
+    minutes per jit and has been seen segfaulting under the accumulated
+    compile load. The TPU hot path is the Pallas windowed kernel above."""
+    bits = (k_limbs[..., :, None]
+            >> jnp.arange(params.LIMB_BITS, dtype=jnp.uint32)) & 1
     bits = bits.reshape(bits.shape[:-2] + (256,))
     bits_t = jnp.moveaxis(bits, -1, 0)  # (256, ...)
 
@@ -185,7 +209,7 @@ def normalize(p):
     inf = F.is_zero(Z)
     # avoid inv(0): substitute 1 for Z at infinity
     Zsafe = jnp.where(inf[..., None], FP.one_mont, Z)
-    Zi = F.inv(Zsafe, FP)
+    Zi = F.batch_inv(Zsafe, FP)
     Zi2 = F.mont_mul(Zi, Zi, FP)
     x = F.mont_mul(X, Zi2, FP)
     y = F.mont_mul(Y, F.mont_mul(Zi, Zi2, FP), FP)

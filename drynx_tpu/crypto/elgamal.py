@@ -85,17 +85,31 @@ class FixedBase:
         return fixed_base_mul(self.table, k_limbs)
 
 
-@jax.jit
-def fixed_base_mul(table, k_limbs):
+def fixed_base_mul(table, k_limbs, n_windows: int = NUM_WINDOWS):
     """k * P via windowed lookup-and-add. k_limbs: (..., 16) plain scalars.
 
-    64 point additions instead of 256 double-and-add steps.
-    """
+    64 point additions instead of 256 double-and-add steps; `n_windows`
+    truncates the ladder for scalars known to be small (k < 16^n_windows —
+    e.g. 16 windows cover any nonnegative int64 plaintext). On TPU the
+    whole ladder runs as one Pallas kernel (crypto/pallas_ops.py)."""
+    from . import pallas_ops as po
+
+    if po.available():
+        batch = k_limbs.shape[:-1]
+        out = po.fixed_base_mul_flat(table,
+                                     k_limbs.reshape((-1, NUM_LIMBS)),
+                                     n_windows=n_windows)
+        return out.reshape(batch + (3, NUM_LIMBS))
+    return _fixed_base_mul_jnp(table, k_limbs, n_windows)
+
+
+@partial(jax.jit, static_argnames="n_windows")
+def _fixed_base_mul_jnp(table, k_limbs, n_windows: int = NUM_WINDOWS):
     # 4 windows per 16-bit limb -> (..., 64) digit array, little-endian.
     shifts = jnp.arange(0, LIMB_BITS, WINDOW_BITS, dtype=jnp.uint32)  # (4,)
     digits = (k_limbs[..., :, None] >> shifts) & jnp.uint32(WINDOW_SIZE - 1)
     digits = digits.reshape(digits.shape[:-2] + (NUM_WINDOWS,))
-    digits_t = jnp.moveaxis(digits, -1, 0)  # (64, ...)
+    digits_t = jnp.moveaxis(digits, -1, 0)[:n_windows]  # (W, ...)
 
     batch = digits.shape[:-1]
     acc0 = C.infinity(batch)
@@ -106,7 +120,7 @@ def fixed_base_mul(table, k_limbs):
         pt = jnp.take(row, digit, axis=0)  # (..., 3, 16)
         return C.add(acc, pt), None
 
-    ws = jnp.arange(NUM_WINDOWS, dtype=jnp.uint32)
+    ws = jnp.arange(n_windows, dtype=jnp.uint32)
     acc, _ = jax.lax.scan(step, acc0, (ws, digits_t))
     return acc
 
@@ -178,6 +192,34 @@ def encrypt_with_tables(base_table, pub_tbl, m_scalars, r_scalars):
     return jnp.stack([K, Cc], axis=-3)
 
 
+# int64 plaintexts fit 16 hex digits: |v| < 2^64 = 16^16
+SMALL_WINDOWS = 16
+
+
+@jax.jit
+def encrypt_ints_with_tables(base_table, pub_tbl, values, r_scalars):
+    """Encrypt SIGNED int64 plaintexts: mB computed as |v|·B over a
+    16-window truncated ladder (4x shorter than the full 64), negated
+    pointwise for v < 0 — exactly m·B since (n−|v|)·B = −(|v|·B)."""
+    values = jnp.asarray(values, dtype=jnp.int64)
+    neg = values < 0
+    # |v| via two's-complement negate in uint64: exact for ALL int64,
+    # including INT64_MIN (where jnp.abs wraps)
+    u = values.astype(jnp.uint64)
+    mag = jnp.where(neg, ~u + jnp.uint64(1), u)
+    limbs = jnp.zeros(values.shape + (NUM_LIMBS,), dtype=jnp.uint32)
+    for k in range(4):  # |v| <= 2^63 fits 4 limbs
+        limbs = limbs.at[..., k].set(
+            (mag >> jnp.uint64(LIMB_BITS * k)).astype(jnp.uint32)
+            & jnp.uint32(LIMB_MASK))
+    K = fixed_base_mul(base_table, r_scalars)
+    mB = fixed_base_mul(base_table, limbs, n_windows=SMALL_WINDOWS)
+    mB = jnp.where(neg[..., None, None], C.neg(mB), mB)
+    rP = fixed_base_mul(pub_tbl, r_scalars)
+    Cc = C.add(mB, rP)
+    return jnp.stack([K, Cc], axis=-3)
+
+
 def encrypt_ints(key, pub_tbl: FixedBase, values, base_tbl: FixedBase = None):
     """Encrypt an int array; returns (ciphertexts (...,2,3,16), r scalars).
 
@@ -186,8 +228,7 @@ def encrypt_ints(key, pub_tbl: FixedBase, values, base_tbl: FixedBase = None):
     base_tbl = base_tbl or BASE_TABLE
     values = jnp.asarray(values)
     r = random_scalars(key, values.shape)
-    m = int_to_scalar(values)
-    ct = encrypt_with_tables(base_tbl.table, pub_tbl.table, m, r)
+    ct = encrypt_ints_with_tables(base_tbl.table, pub_tbl.table, values, r)
     return ct, r
 
 
@@ -335,7 +376,8 @@ def ct_to_ref(ct):
 __all__ = [
     "keygen", "secret_to_limbs", "FixedBase", "fixed_base_mul", "BASE_TABLE",
     "random_scalars", "int_to_scalar", "pub_table", "encrypt_with_tables",
-    "encrypt_ints", "ct_add", "ct_sub", "ct_scalar_mul", "ct_zero",
+    "encrypt_ints_with_tables", "encrypt_ints", "ct_add", "ct_sub",
+    "ct_scalar_mul", "ct_zero",
     "decrypt_point", "decrypt_check_zero", "DecryptionTable", "decrypt_ints",
     "encrypt_ref", "ct_from_ref", "ct_to_ref",
 ]

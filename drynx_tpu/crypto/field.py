@@ -321,6 +321,31 @@ def inv(a, ctx: ModCtx = FP):
 
 
 @partial(jax.jit, static_argnames="ctx")
+def batch_inv(a, ctx: ModCtx = FP):
+    """Montgomery batch inversion: ONE Fermat inversion + O(n) products for
+    the whole batch (all leading dims). Inputs in Montgomery form, must be
+    nonzero (a zero poisons the whole batch — callers substitute 1 first,
+    as curve.normalize does for points at infinity).
+
+    prefix/suffix products via associative_scan (log-depth), then
+    a_i^{-1} = P_{i-1} * S_{i+1} * (P_{n-1})^{-1}.
+    """
+    shape = a.shape
+    flat = a.reshape((-1, NUM_LIMBS))
+    if flat.shape[0] == 0:
+        return a
+    mm = partial(mont_mul, ctx=ctx)
+    pref = jax.lax.associative_scan(mm, flat)
+    suff = jax.lax.associative_scan(mm, flat, reverse=True)
+    total_inv = inv(pref[-1], ctx)
+    one = jnp.broadcast_to(ctx.one_mont, (1, NUM_LIMBS))
+    left = jnp.concatenate([one, pref[:-1]], axis=0)
+    right = jnp.concatenate([suff[1:], one], axis=0)
+    out = mm(mm(left, right), total_inv)
+    return out.reshape(shape)
+
+
+@partial(jax.jit, static_argnames="ctx")
 def reduce_512(hi, lo, ctx: ModCtx = FP):
     """(hi*2^256 + lo) mod m, both 16-limb plain (non-Montgomery) values.
 
@@ -339,5 +364,5 @@ __all__ = [
     "from_int", "to_int",
     "add", "sub", "neg", "is_zero", "eq",
     "mont_mul", "mont_sqr", "to_mont", "from_mont",
-    "pow_const", "inv", "reduce_512",
+    "pow_const", "inv", "batch_inv", "reduce_512",
 ]

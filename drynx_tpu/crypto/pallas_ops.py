@@ -1,0 +1,502 @@
+"""Pallas TPU kernels for the hot crypto ops (SURVEY.md §7 stage 1).
+
+The jnp field/curve layers put the 16 scalar limbs on the MINOR axis, so a
+(B, 16) uint32 op wastes 7/8 of every 128-wide VPU lane register and every
+scan step is a separate XLA op with HBM round-trips. These kernels flip the
+layout — limbs on sublanes, batch on lanes — and run the entire windowed
+scalar-multiplication ladder in one kernel: table build, digit scan, field
+arithmetic all in VMEM/registers. This is the TPU-native replacement for the
+per-point goroutine fan-out around kyber Point.Mul in the reference (unlynx
+StartParallelize at lib/range/range_proof.go:75 and 30+ sites).
+
+Field elements inside a kernel are (16, B) uint32 traced values (16-bit
+limbs, little-endian, Montgomery form for Fp); points are (X, Y, Z) tuples of
+those (Jacobian, Z == 0 at infinity) — the same representation as
+crypto/field.py / crypto/curve.py, transposed.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import params
+
+NL = params.NUM_LIMBS            # 16
+LB = params.LIMB_BITS            # 16
+MASK = np.uint32(params.LIMB_MASK)  # numpy literal: safe inside kernels
+
+_M_FP = np.asarray(params.to_limbs(params.P), dtype=np.uint32)
+_NPRIME_FP = np.uint32(params.NPRIME)
+
+LANES = 128                      # batch tile width
+
+# DRYNX_PALLAS_INTERPRET=1 runs the kernels through the Pallas interpreter
+# (any backend) — used by the CPU test suite to cover the kernel code paths.
+INTERPRET = os.environ.get("DRYNX_PALLAS_INTERPRET", "0") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Field arithmetic on (16, B) tiles (trace-time unrolled; ~16-step chains)
+# ---------------------------------------------------------------------------
+
+def _sub_limbs(a, b):
+    """a - b with borrow chain. Returns ((16, B), borrow (B,))."""
+    outs = []
+    borrow = jnp.zeros(a.shape[1:], jnp.uint32)
+    for k in range(NL):
+        v = a[k] - b[k] - borrow
+        outs.append(v & MASK)
+        borrow = (v >> LB) & np.uint32(1)
+    return jnp.stack(outs), borrow
+
+
+def _carry16(rows, carry0=None):
+    """Propagate carries down 16 rows (values < 2^31). -> ((16,B), carry)."""
+    outs = []
+    c = jnp.zeros(rows.shape[1:], jnp.uint32) if carry0 is None else carry0
+    for k in range(NL):
+        v = rows[k] + c
+        outs.append(v & MASK)
+        c = v >> LB
+    return jnp.stack(outs), c
+
+
+def fadd(a, b, m):
+    """(a + b) mod m on (16, B) tiles, inputs normalized."""
+    s, carry = _carry16(a + b)
+    diff, borrow = _sub_limbs(s, jnp.broadcast_to(m, s.shape))
+    use_diff = (borrow == 0) | (carry > 0)
+    return jnp.where(use_diff[None, :], diff, s)
+
+
+def fsub(a, b, m):
+    diff, borrow = _sub_limbs(a, b)
+    plus_m, _ = _carry16(diff + m)
+    return jnp.where((borrow == 1)[None, :], plus_m, diff)
+
+
+def fis_zero(a):
+    """(B,) bool: all 16 limbs zero. Unrolled OR-tree — Mosaic lowers
+    boolean sublane reductions through an unsupported float path."""
+    orv = a[0]
+    for k in range(1, NL):
+        orv = orv | a[k]
+    return orv == 0
+
+
+def _padded_add(cols, block, off):
+    """cols (33, B) + block (R, B) placed at row offset `off` (static).
+
+    Mosaic has no scatter; static-offset placement is a concat of zero rows.
+    """
+    R = block.shape[0]
+    parts = []
+    if off:
+        parts.append(jnp.zeros((off,) + block.shape[1:], jnp.uint32))
+    parts.append(block)
+    tail = cols.shape[0] - off - R
+    if tail:
+        parts.append(jnp.zeros((tail,) + block.shape[1:], jnp.uint32))
+    return cols + jnp.concatenate(parts, axis=0)
+
+
+def mont_mul(a, b, m, nprime):
+    """Montgomery product on (16, B) tiles (same math as field.mont_mul's
+    unrolled path: schoolbook columns + 16 interleaved reduction steps)."""
+    B = a.shape[1]
+    zrow = jnp.zeros((1, B), jnp.uint32)
+    cols = jnp.zeros((2 * NL + 1, B), jnp.uint32)
+    for j in range(NL):
+        p = a * b[j][None, :]        # (16, B), full 32-bit products
+        # lo lands in cols[j:j+16], hi in cols[j+1:j+17] -> one (17,B) block
+        add17 = (jnp.concatenate([p & MASK, zrow], axis=0)
+                 + jnp.concatenate([zrow, p >> LB], axis=0))
+        cols = _padded_add(cols, add17, j)
+    carry = jnp.zeros((B,), jnp.uint32)
+    for i in range(NL):
+        v = cols[i] + carry
+        mfac = ((v & MASK) * nprime) & MASK
+        mp = m * mfac[None, :]       # (16, B)
+        mlo = mp & MASK
+        carry = (v + mlo[0]) >> LB
+        # mlo[1:] lands in cols[i+1:i+16], hi in cols[i+1:i+17]
+        add16 = (jnp.concatenate([mlo[1:], zrow], axis=0) + (mp >> LB))
+        cols = _padded_add(cols, add16, i + 1)
+    res, c = _carry16(cols[NL:2 * NL], carry0=carry)
+    top = cols[2 * NL] + c
+    diff, borrow = _sub_limbs(res, jnp.broadcast_to(m, res.shape))
+    use_diff = (borrow == 0) | (top > 0)
+    return jnp.where(use_diff[None, :], diff, res)
+
+
+# ---------------------------------------------------------------------------
+# G1 group law on (X, Y, Z) tuples of (16, B) tiles (mirrors crypto/curve.py)
+# ---------------------------------------------------------------------------
+
+def _pt_select(cond, p, q):
+    """Per-lane select: cond (B,) bool -> p where true else q."""
+    c = cond[None, :]
+    return tuple(jnp.where(c, a, b) for a, b in zip(p, q))
+
+
+def make_group(m_const, nprime):
+    """Bind the modulus constants once; returns (double, add_complete)."""
+    mul = lambda a, b: mont_mul(a, b, m_const, nprime)
+    add_ = lambda a, b: fadd(a, b, m_const)
+    sub_ = lambda a, b: fsub(a, b, m_const)
+
+    def pdouble(p):
+        X, Y, Z = p
+        A = mul(X, X)
+        Bv = mul(Y, Y)
+        Cv = mul(Bv, Bv)
+        t0 = add_(X, Bv)
+        t = sub_(mul(t0, t0), add_(A, Cv))
+        D = add_(t, t)
+        E = add_(add_(A, A), A)
+        Fv = mul(E, E)
+        X3 = sub_(Fv, add_(D, D))
+        C2 = add_(Cv, Cv)
+        C4 = add_(C2, C2)
+        C8 = add_(C4, C4)
+        Y3 = sub_(mul(E, sub_(D, X3)), C8)
+        YZ = mul(Y, Z)
+        Z3 = add_(YZ, YZ)
+        return (X3, Y3, Z3)
+
+    def padd(p, q):
+        X1, Y1, Z1 = p
+        X2, Y2, Z2 = q
+        Z1Z1 = mul(Z1, Z1)
+        Z2Z2 = mul(Z2, Z2)
+        U1 = mul(X1, Z2Z2)
+        U2 = mul(X2, Z1Z1)
+        S1 = mul(Y1, mul(Z2, Z2Z2))
+        S2 = mul(Y2, mul(Z1, Z1Z1))
+        H = sub_(U2, U1)
+        HH = add_(H, H)
+        I = mul(HH, HH)
+        J = mul(H, I)
+        r = sub_(S2, S1)
+        r = add_(r, r)
+        V = mul(U1, I)
+        X3 = sub_(sub_(mul(r, r), J), add_(V, V))
+        SJ = mul(S1, J)
+        Y3 = sub_(mul(r, sub_(V, X3)), add_(SJ, SJ))
+        t1 = add_(Z1, Z2)
+        ZZ = sub_(sub_(mul(t1, t1), Z1Z1), Z2Z2)
+        Z3 = mul(ZZ, H)
+        res = (X3, Y3, Z3)
+
+        p_inf = fis_zero(Z1)
+        q_inf = fis_zero(Z2)
+        h0 = fis_zero(H)
+        r0 = fis_zero(r)
+        res = _pt_select(h0 & r0 & ~p_inf & ~q_inf, pdouble(p), res)
+        res = _pt_select(h0 & ~r0 & ~p_inf & ~q_inf, _inf_like(p), res)
+        res = _pt_select(q_inf, p, res)
+        res = _pt_select(p_inf, q, res)
+        return res
+
+    return pdouble, padd
+
+
+def _inf_like(p):
+    """Infinity point tiles shaped like p: X=Y=1 (Mont form irrelevant,
+    any nonzero works for Z==0 semantics — use 1), Z=0."""
+    one_row = jnp.ones((1,) + p[0].shape[1:], jnp.uint32)
+    zero_rows = jnp.zeros((NL - 1,) + p[0].shape[1:], jnp.uint32)
+    X = jnp.concatenate([one_row, zero_rows], axis=0)
+    return (X, X, jnp.zeros_like(p[2]))
+
+
+# ---------------------------------------------------------------------------
+# Windowed scalar-mult kernel: whole ladder in one pallas_call
+# ---------------------------------------------------------------------------
+
+def _scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref):
+    m = m_ref[:]                              # (16, 1) modulus limbs
+    nprime = np_ref[0, 0]
+    pdouble, padd = make_group(m, nprime)
+
+    P = (p_ref[0], p_ref[1], p_ref[2])        # each (16, B)
+    k = k_ref[:]                              # (16, B)
+
+    # table[d] = d*P: T[2k]=dbl(T[k]), T[2k+1]=T[2k]+P (7 dbl + 7 add)
+    tab = [_inf_like(P), P]
+    for d in range(2, 16):
+        tab.append(pdouble(tab[d // 2]) if d % 2 == 0
+                   else padd(tab[d - 1], P))
+    # stack for per-lane constant-time select: (16, 3, 16, B)
+    tabX = jnp.stack([t[0] for t in tab])
+    tabY = jnp.stack([t[1] for t in tab])
+    tabZ = jnp.stack([t[2] for t in tab])
+
+    # 64 4-bit digits, MSB-first rows: digits[w] = digit 63-w, staged in a
+    # VMEM scratch so the loop body can dynamic-slice them (register arrays
+    # cannot be dynamically indexed in Mosaic)
+    rows = []
+    for w in range(63, -1, -1):
+        limb, s = divmod(w, 4)
+        rows.append((k[limb] >> np.uint32(4 * s)) & np.uint32(0xF))
+    dig_ref[:] = jnp.stack(rows)              # (64, B) MSB first
+
+    def select(d):
+        # per-lane table lookup via 16 selects (constant-time)
+        accX, accY, accZ = tabX[0], tabY[0], tabZ[0]
+        for v in range(1, 16):
+            mask = (d == v)[None, :]
+            accX = jnp.where(mask, tabX[v], accX)
+            accY = jnp.where(mask, tabY[v], accY)
+            accZ = jnp.where(mask, tabZ[v], accZ)
+        return (accX, accY, accZ)
+
+    acc0 = select(dig_ref[0])
+
+    def body(w, acc):
+        acc = pdouble(pdouble(pdouble(pdouble(acc))))
+        d = dig_ref[pl.ds(w, 1), :][0]
+        return padd(acc, select(d))
+
+    # int32 bounds: with jax_enable_x64 a python-int fori_loop carries an
+    # i64 induction var, which Mosaic cannot lower
+    acc = jax.lax.fori_loop(jnp.int32(1), jnp.int32(64), body, acc0)
+    o_ref[0] = acc[0]
+    o_ref[1] = acc[1]
+    o_ref[2] = acc[2]
+
+
+@jax.jit
+def scalar_mul_flat(p, k):
+    """k*P batched: p (N, 3, 16) Jacobian Montgomery, k (N, 16) plain
+    scalars -> (N, 3, 16). Pads N up to a LANES multiple and tiles."""
+    N = p.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    pt = _pad_lanes(jnp.transpose(p, (1, 2, 0)), Np)   # (3, 16, Np)
+    kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)      # (16, Np)
+
+    m_in = jnp.asarray(_M_FP[:, None])
+    np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
+    # x64 mode would make BlockSpec index maps / loop bounds i64, which
+    # Mosaic cannot legalize; every value here is uint32, so drop to x32
+    with jax.enable_x64(False):
+        out = _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np)
+    return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np):
+    return pl.pallas_call(
+        _scalar_mul_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((NL, LANES), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((3, NL, Np), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((64, LANES), jnp.uint32)],
+        interpret=INTERPRET,
+    )(m_in, np_in, pt, kt)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-base windowed mult kernel: shared (64, 16)-entry table, add-only
+# ---------------------------------------------------------------------------
+
+def _fixed_base_kernel(m_ref, np_ref, tab_ref, k_ref, o_ref, dig_ref):
+    """tab_ref: (W, 16, 48) — row w holds [16 limbs x (coord c * 16 + digit
+    v)] of the precomputed points v * 16^w * P (v=0 row is infinity).
+    W table-gather adds, no doubles (the 16^w factors are baked in); W < 64
+    serves scalars known to be < 16^W (small plaintexts)."""
+    m = m_ref[:]
+    nprime = np_ref[0, 0]
+    pdouble, padd = make_group(m, nprime)
+    k = k_ref[:]                              # (16, B)
+    B = k.shape[1]
+    W = dig_ref.shape[0]
+
+    rows = []
+    for w in range(W):                        # little-endian digit order
+        limb, s = divmod(w, 4)
+        rows.append((k[limb] >> np.uint32(4 * s)) & np.uint32(0xF))
+    dig_ref[:] = jnp.stack(rows)              # (W, B)
+
+    def sel(row, d):
+        # row (16, 48) = limbs x (c*16+v); per-lane digit select by splat
+        pts = []
+        for c in range(3):
+            cand = row[:, c * 16:(c + 1) * 16]          # (16, 16)
+            acc = jnp.broadcast_to(cand[:, 0:1], (NL, B))
+            for v in range(1, 16):
+                splat = jnp.broadcast_to(cand[:, v:v + 1], (NL, B))
+                acc = jnp.where((d == v)[None, :], splat, acc)
+            pts.append(acc)
+        return tuple(pts)
+
+    def body(w, acc):
+        row = tab_ref[pl.ds(w, 1)][0]         # (16, 48)
+        d = dig_ref[pl.ds(w, 1), :][0]        # (B,)
+        return padd(acc, sel(row, d))
+
+    zero = jnp.zeros((NL, B), jnp.uint32)
+    acc0 = _inf_like((zero, zero, zero))
+    acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(W), body, acc0)
+    o_ref[0] = acc[0]
+    o_ref[1] = acc[1]
+    o_ref[2] = acc[2]
+
+
+@functools.partial(jax.jit, static_argnames="n_windows")
+def fixed_base_mul_flat(table, k, n_windows: int = 64):
+    """k*P via a shared fixed-base window table. table: (64, 16, 3, 16) as
+    built by elgamal.FixedBase; k: (N, 16) plain scalars -> (N, 3, 16).
+    n_windows < 64 truncates the ladder for small scalars (k < 16^W)."""
+    N = k.shape[0]
+    W = n_windows
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)      # (16, Np)
+    # (w, v, c, l) -> (w, l, c, v) -> (W, 16, 48)
+    tt = jnp.transpose(table[:W], (0, 3, 2, 1)).reshape(W, NL, 48)
+
+    m_in = jnp.asarray(_M_FP[:, None])
+    np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _fixed_base_kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((W, NL, 48), lambda i: (0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((NL, LANES), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((3, NL, Np), jnp.uint32),
+            scratch_shapes=[pltpu.VMEM((W, LANES), jnp.uint32)],
+            interpret=INTERPRET,
+        )(m_in, np_in, tt, kt)
+    return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+# ---------------------------------------------------------------------------
+# Batched complete point add + R-way reduce kernels
+# ---------------------------------------------------------------------------
+
+def _point_add_kernel(m_ref, np_ref, p_ref, q_ref, o_ref):
+    m = m_ref[:]
+    _, padd = make_group(m, np_ref[0, 0])
+    r = padd((p_ref[0], p_ref[1], p_ref[2]),
+             (q_ref[0], q_ref[1], q_ref[2]))
+    o_ref[0], o_ref[1], o_ref[2] = r
+
+
+def _point_reduce_kernel(m_ref, np_ref, p_ref, o_ref):
+    """p_ref: (R, 3, 16, B) — sum rows 0..R-1 with the complete group add."""
+    m = m_ref[:]
+    _, padd = make_group(m, np_ref[0, 0])
+    R = p_ref.shape[0]
+    acc = (p_ref[0, 0], p_ref[0, 1], p_ref[0, 2])
+    for r in range(1, R):                     # R is small + static: unroll
+        acc = padd(acc, (p_ref[r, 0], p_ref[r, 1], p_ref[r, 2]))
+    o_ref[0], o_ref[1], o_ref[2] = acc
+
+
+def _mk_point_io(n_tiles, Np, extra=None):
+    specs = [
+        pl.BlockSpec((NL, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+    ]
+    if extra:
+        specs += extra
+    return dict(
+        grid=(n_tiles,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((3, NL, Np), jnp.uint32),
+    )
+
+
+def _pad_lanes(x, Np):
+    N = x.shape[-1]
+    if N == Np:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, Np - N)]
+    return jnp.pad(x, pad)
+
+
+@jax.jit
+def point_add_flat(p, q):
+    """Complete add, (N, 3, 16) x (N, 3, 16) -> (N, 3, 16)."""
+    N = p.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    pt = _pad_lanes(jnp.transpose(p, (1, 2, 0)), Np)
+    qt = _pad_lanes(jnp.transpose(q, (1, 2, 0)), Np)
+    m_in = jnp.asarray(_M_FP[:, None])
+    np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
+    io = _mk_point_io(n_tiles, Np, extra=[
+        pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
+                     memory_space=pltpu.VMEM),
+    ])
+    with jax.enable_x64(False):
+        out = pl.pallas_call(_point_add_kernel, interpret=INTERPRET, **io)(m_in, np_in, pt, qt)
+    return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+@jax.jit
+def point_reduce_flat(pts):
+    """Group-add reduce over axis 0: (R, N, 3, 16) -> (N, 3, 16), one
+    kernel call (replaces log2(R) jnp tree-reduce rounds)."""
+    R, N = pts.shape[0], pts.shape[1]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    pt = _pad_lanes(jnp.transpose(pts, (0, 2, 3, 1)), Np)  # (R,3,16,Np)
+    m_in = jnp.asarray(_M_FP[:, None])
+    np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
+    io = _mk_point_io(n_tiles, Np, extra=[
+        pl.BlockSpec((R, 3, NL, LANES), lambda i: (0, 0, 0, i),
+                     memory_space=pltpu.VMEM),
+    ])
+    with jax.enable_x64(False):
+        out = pl.pallas_call(_point_reduce_kernel, interpret=INTERPRET, **io)(m_in, np_in, pt)
+    return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+def available() -> bool:
+    """True when the Mosaic TPU path can run here (kill: DRYNX_NO_PALLAS=1)."""
+    if os.environ.get("DRYNX_NO_PALLAS", "0") == "1":
+        return False
+    if INTERPRET:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+__all__ = ["scalar_mul_flat", "fixed_base_mul_flat", "point_add_flat",
+           "point_reduce_flat", "mont_mul", "fadd", "fsub", "make_group",
+           "available", "LANES"]

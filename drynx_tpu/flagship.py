@@ -56,7 +56,15 @@ class SurveySetup:
 
 
 def _tree_reduce_points(pts):
-    """Reduce axis 0 of a point/ct tensor by repeated halving (log2 depth)."""
+    """Reduce axis 0 of a point/ct tensor by repeated halving (log2 depth);
+    on TPU the whole reduction is one Pallas kernel call."""
+    from .crypto import pallas_ops as po
+
+    if po.available() and pts.shape[0] > 1:
+        R = pts.shape[0]
+        mid = pts.shape[1:-2]
+        out = po.point_reduce_flat(pts.reshape((R, -1, 3, 16)))
+        return out.reshape(mid + (3, 16))
     n = pts.shape[0]
     while n > 1:
         half = n // 2
@@ -87,15 +95,16 @@ def build_pipeline(setup: SurveySetup, params: lr.LRParams):
     keys, xs, ysign, vals = dl.keys, dl.xs, dl.ysign, dl.vals
 
     def fn(dp_stats, enc_rs, ks_rs):
-        # DP-side: encrypt every stat of every DP (one big batch).
-        m = eg.int_to_scalar(dp_stats)
-        cts = eg.encrypt_with_tables(base_tbl, coll_tbl, m, enc_rs)
+        # DP-side: encrypt every stat of every DP (one big batch; int64
+        # plaintexts ride the truncated small-scalar ladder).
+        cts = eg.encrypt_ints_with_tables(base_tbl, coll_tbl, dp_stats,
+                                          enc_rs)
         # Collective aggregation (CN tree -> on-chip tree reduce).
         agg = _tree_reduce_points(cts)
-        # Key switch: per-server contributions (vmapped), then reduce.
-        kc, cc = jax.vmap(
-            lambda x, r: col.keyswitch_contribution(agg, x, r, q_tbl)
-        )(srv_x, ks_rs)
+        # Key switch: per-server contributions (broadcast batch — one big
+        # flat batch feeds the Pallas ladder kernel), then reduce.
+        kc, cc = col.keyswitch_contribution(
+            agg[None], srv_x[:, None, :], ks_rs, q_tbl)
         switched = col.keyswitch_finish(
             agg, _tree_reduce_points(kc), _tree_reduce_points(cc))
         # Querier decrypt + discrete log.
@@ -132,7 +141,7 @@ def pima_shaped_problem(num_dps: int = 10, n_records: int = 768, d: int = 8,
     p = lr.LRParams(
         k=2, precision=1.0, lambda_=1.0, step=0.1,
         max_iterations=max_iterations, n_features=d,
-        n_records=len(y),
+        n_records=len(y), dtype="float32",
         means=tuple(np.mean(X, 0)), std_devs=tuple(np.std(X, 0)))
     return X, y, p
 

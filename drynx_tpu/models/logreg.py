@@ -51,6 +51,10 @@ class LRParams:
     means: tuple | None = None    # global standardisation, optional
     std_devs: tuple | None = None
     coeffs: tuple = MIN_AREA_COEFFS
+    # GD dtype: float64 matches the reference's math everywhere; float32 is
+    # the TPU-native choice (f64 is software-emulated on TPU) — use it when
+    # training on-device; the decrypted-ints-identical invariant is unaffected
+    dtype: str = "float64"
 
     def num_coeffs(self) -> int:
         dp1 = self.n_features + 1
@@ -137,7 +141,7 @@ def cost(w, Ts, N, lambda_, coeffs):
     logistic_regression.go:526-560 — with the per-degree coefficients
     applied independently, as the reference's Gradient does)."""
     dp1 = w.shape[0]
-    c = jnp.float64(0.0)
+    c = jnp.zeros((), w.dtype)
     for j, Tf in enumerate(Ts, start=1):
         contr = Tf.reshape((dp1,) * j)
         for _ in range(j):
@@ -158,11 +162,13 @@ def train(Ts, p: LRParams):
     """GD on the approximated cost; jitted fori_loop. Returns weights."""
     dp1 = p.n_features + 1
     coeffs = tuple(p.coeffs)
+    dt = jnp.dtype(p.dtype)
+    Ts = [jnp.asarray(T, dtype=dt) for T in Ts]
     if p.k == 1:
         return closed_form_k1(Ts[0], p.lambda_, coeffs)
 
-    w0 = (jnp.asarray(p.initial_weights, dtype=jnp.float64)
-          if len(p.initial_weights) else jnp.zeros((dp1,), jnp.float64))
+    w0 = (jnp.asarray(p.initial_weights, dtype=dt)
+          if len(p.initial_weights) else jnp.zeros((dp1,), dt))
     N = float(p.n_records)
 
     cost_fn = lambda w: cost(w, Ts, N, p.lambda_, coeffs)
@@ -178,7 +184,7 @@ def train(Ts, p: LRParams):
         return (w, best_w, best_c)
 
     w, best_w, best_c = jax.lax.fori_loop(
-        0, p.max_iterations, body, (w0, w0, jnp.float64(jnp.inf)))
+        0, p.max_iterations, body, (w0, w0, jnp.asarray(jnp.inf, dt)))
     final_c = cost_fn(w)
     return jnp.where(final_c < best_c, w, best_w)
 

@@ -1,0 +1,68 @@
+"""Range-proof create/verify throughput on the current device.
+
+The reference's dominant cost is VN range verification (21.73 s in the
+TIFS timeline workload vs 0.79 s DP encoding — BASELINE.md). This measures
+the TPU path: one proof batch over a Pima-shaped ciphertext vector.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def main():
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.proofs import range_proof as rp
+
+    rng = np.random.default_rng(3)
+    u, l, V, ns = 4, 5, 90, 3          # Pima-shaped: V=90 cts, 3 CNs
+    sigs = [rp.init_range_sig(u, rng) for _ in range(ns)]
+
+    x, pub = eg.keygen(rng)
+    ptab = eg.pub_table(pub)
+    values = rng.integers(0, u ** l, size=(V,)).astype(np.int64)
+    cts, rs = eg.encrypt_ints(jax.random.PRNGKey(0), ptab, values)
+
+    key = jax.random.PRNGKey(1)
+    t0 = time.perf_counter()
+    proof = rp.create_range_proofs(key, values, rs, cts, sigs, u, l,
+                                   ptab.table)
+    jax.block_until_ready((proof.zv, proof.v_pts, proof.a, proof.d,
+                           proof.zphi, proof.zr))
+    create_first = time.perf_counter() - t0
+
+    best_create = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p2 = rp.create_range_proofs(key, values, rs, cts, sigs, u, l,
+                                    ptab.table)
+        jax.block_until_ready((p2.zv, p2.v_pts, p2.a, p2.d))
+        best_create = min(best_create, time.perf_counter() - t0)
+
+    sig_pubs = [s.public for s in sigs]
+    t0 = time.perf_counter()
+    ok = rp.verify_range_proofs(proof, sig_pubs, ptab.table)
+    verify_first = time.perf_counter() - t0
+    assert bool(np.asarray(ok).all()), "proof batch failed verification"
+
+    best_verify = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        okv = rp.verify_range_proofs(proof, sig_pubs, ptab.table)
+        assert bool(np.asarray(okv).all())
+        best_verify = min(best_verify, time.perf_counter() - t0)
+
+    n_proofs = ns * V * l
+    print(f"create: first {create_first:.2f}s (compile), best {best_create:.4f}s "
+          f"({n_proofs / best_create:.0f} digit-proofs/s)")
+    print(f"verify: first {verify_first:.2f}s (compile), best {best_verify:.4f}s "
+          f"({n_proofs / best_verify:.0f} digit-proofs/s)")
+    print(f"reference VN range-verify phase: 21.73 s (TIFS timeline)")
+
+
+if __name__ == "__main__":
+    main()

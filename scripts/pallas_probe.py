@@ -1,0 +1,92 @@
+"""TPU probe: pallas scalar-mul kernel vs jnp path — correctness + speed."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drynx_tpu.crypto import curve as C
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.crypto import field as F
+from drynx_tpu.crypto import pallas_ops as po
+from drynx_tpu.crypto import params, refimpl
+
+
+def main():
+    print("backend:", jax.default_backend())
+    rng = np.random.default_rng(5)
+    N = 270
+
+    # random points: k_i * G via oracle, random scalars
+    ks = [int.from_bytes(rng.bytes(32), "little") % params.N for _ in range(N)]
+    pts = [refimpl.g1_mul(refimpl.G1, k) for k in ks]
+    p_dev = jnp.asarray(C.from_ref_batch(pts))          # (N, 3, 16)
+    ss = [int.from_bytes(rng.bytes(32), "little") % params.N for _ in range(N)]
+    s_dev = jnp.asarray(F.from_int(ss))                 # (N, 16)
+
+    # include edge cases: scalar 0, scalar 1, infinity point
+    s_dev = s_dev.at[0].set(0)
+    s_dev = s_dev.at[1].set(jnp.zeros(16, jnp.uint32).at[0].set(1))
+    p_dev = p_dev.at[2].set(jnp.asarray(C.from_ref(None)))
+
+    out_p = po.scalar_mul_flat(p_dev, s_dev)
+    jax.block_until_ready(out_p)
+    out_j = C._scalar_mul_jnp(p_dev, s_dev)
+    jax.block_until_ready(out_j)
+
+    # compare affine forms
+    ax_p, ay_p, inf_p = C.normalize(out_p)
+    ax_j, ay_j, inf_j = C.normalize(out_j)
+    ok_inf = bool(jnp.all(inf_p == inf_j))
+    fin = ~np.asarray(inf_j)
+    ok_x = bool(np.all(np.asarray(ax_p)[fin] == np.asarray(ax_j)[fin]))
+    ok_y = bool(np.all(np.asarray(ay_p)[fin] == np.asarray(ay_j)[fin]))
+    print(f"match: inf={ok_inf} x={ok_x} y={ok_y}")
+    assert ok_inf and ok_x and ok_y
+
+    # spot-check one against the oracle
+    want = refimpl.g1_mul(pts[5], ss[5])
+    got = C.to_ref(out_p[5])
+    assert got == want, "oracle mismatch"
+    print("oracle spot-check ok")
+
+    for name, fn in [("pallas", po.scalar_mul_flat),
+                     ("jnp", C._scalar_mul_jnp)]:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(p_dev, s_dev))
+            best = min(best, time.perf_counter() - t0)
+        print(f"{name}: {best*1000:.2f} ms for N={N}")
+
+
+def probe_fixed_base():
+    rng = np.random.default_rng(7)
+    N = 900
+    ss = [int.from_bytes(rng.bytes(32), "little") % params.N for _ in range(N)]
+    s_dev = jnp.asarray(F.from_int(ss))
+    out_p = po.fixed_base_mul_flat(eg.BASE_TABLE.table, s_dev)
+    out_j = eg._fixed_base_mul_jnp(eg.BASE_TABLE.table, s_dev)
+    ax_p, ay_p, inf_p = C.normalize(out_p)
+    ax_j, ay_j, inf_j = C.normalize(out_j)
+    assert bool(jnp.all(inf_p == inf_j))
+    assert bool(jnp.all(ax_p == ax_j)) and bool(jnp.all(ay_p == ay_j))
+    assert C.to_ref(out_p[11]) == refimpl.g1_mul(refimpl.G1, ss[11])
+    print("fixed-base match + oracle ok")
+    for name, fn in [("pallas-fb", po.fixed_base_mul_flat),
+                     ("jnp-fb", eg._fixed_base_mul_jnp)]:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(eg.BASE_TABLE.table, s_dev))
+            best = min(best, time.perf_counter() - t0)
+        print(f"{name}: {best*1000:.2f} ms for N={N}")
+
+
+if __name__ == "__main__":
+    main()
+    probe_fixed_base()

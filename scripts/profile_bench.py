@@ -1,0 +1,78 @@
+"""Phase-level profiling of the flagship pipeline on the current device."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drynx_tpu import flagship
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.crypto import curve as C
+
+
+def t(fn, *args, n=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    from drynx_tpu.models import logreg as lr
+    from drynx_tpu.parallel import collective as col
+
+    num_dps, n_servers = 10, 3
+    X, y, params = flagship.pima_shaped_problem(
+        num_dps=num_dps, n_records=768, d=8, max_iterations=450)
+    setup = flagship.SurveySetup.create(n_servers=n_servers, dlog_limit=10000)
+    stats, enc_rs, _, k2 = flagship.make_inputs(X, y, params, num_dps)
+    V = stats.shape[1]
+    ks_rs = eg.random_scalars(k2, (n_servers, V))
+
+    base_tbl = eg.BASE_TABLE.table
+    coll_tbl = setup.coll_pub_table
+    q_tbl = setup.query_pub_table
+    srv_x = jnp.asarray(setup.server_secrets)
+    qx = jnp.asarray(eg.secret_to_limbs(setup.query_secret))
+    dl = setup.dlog
+
+    enc = jax.jit(lambda s, r: eg.encrypt_ints_with_tables(
+        base_tbl, coll_tbl, s, r))
+    dt, cts = t(enc, stats, enc_rs)
+    print(f"encrypt ({num_dps}x{V}): {dt:.4f}s")
+
+    aggf = jax.jit(flagship._tree_reduce_points)
+    dt, agg = t(aggf, cts)
+    print(f"aggregate: {dt:.4f}s")
+
+    ksc = jax.jit(lambda a, x, r: col.keyswitch_contribution(
+        a[None], x[:, None, :], r, q_tbl))
+    dt, (kc, cc) = t(ksc, agg, srv_x, ks_rs)
+    print(f"keyswitch contributions: {dt:.4f}s")
+
+    fin = jax.jit(lambda a, kc, cc: col.keyswitch_finish(
+        a, flagship._tree_reduce_points(kc), flagship._tree_reduce_points(cc)))
+    dt, switched = t(fin, agg, kc, cc)
+    print(f"keyswitch finish: {dt:.4f}s")
+
+    decf = jax.jit(lambda s: eg._table_lookup(
+        dl.keys, dl.xs, dl.ysign, dl.vals, eg.decrypt_point(s, qx)))
+    dt, (dec, found) = t(decf, switched)
+    print(f"decrypt+dlog: {dt:.4f}s")
+
+    trainf = jax.jit(lambda d: lr.train(lr.unpack(d, params), params))
+    dt, w = t(trainf, dec)
+    print(f"GD train: {dt:.4f}s")
+
+
+if __name__ == "__main__":
+    main()

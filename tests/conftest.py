@@ -9,6 +9,23 @@ TPU) via sitecustomize, so a plain env override is not enough: we also update
 jax.config before any backend is instantiated.
 """
 import os
+import resource
+
+# XLA's CPU compiler recurses deeply on the crypto modules' giant graphs;
+# with the default 8 MB pthread stacks (inherited from RLIMIT_STACK at
+# thread creation) it segfaults inside backend_compile — observed at
+# fp12.pow_const, the G2 group law, and predict_homomorphic. Raise the
+# limit BEFORE jax spawns its compile threads.
+# NOTE: must be a large FINITE value — with RLIMIT_STACK=unlimited glibc
+# falls back to the 8 MB default for new pthreads. Keep the existing hard
+# limit (raising it needs privileges); cap the soft limit to it.
+_STACK = 1 << 30  # 1 GiB
+try:
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    _want = _STACK if _hard == resource.RLIM_INFINITY else min(_STACK, _hard)
+    resource.setrlimit(resource.RLIMIT_STACK, (_want, _hard))
+except (ValueError, OSError):
+    pass
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -19,6 +36,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # reloaded in a process that detects a different feature set.
 if "xla_cpu_max_isa" not in _flags:
     _flags = (_flags + " --xla_cpu_max_isa=AVX2").strip()
+# Unoptimized CPU codegen: the crypto test modules are huge (256-step
+# scans over pairing towers) and the optimizing CPU pipeline has segfaulted
+# under the accumulated compile load of a full suite run (observed crashes
+# inside backend_compile at fp12.pow_const / G2 group law). Tests check
+# semantics, not CPU speed; opt level 0 compiles far faster and smaller.
+if "xla_backend_optimization_level" not in _flags:
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
 os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402

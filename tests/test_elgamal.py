@@ -87,3 +87,22 @@ def test_random_scalars_in_range_and_distinct():
     ints = F.to_int(np.asarray(s))
     assert len({int(i) for i in ints}) == 8
     assert all(0 <= int(i) < params.N for i in ints)
+
+
+def test_small_scalar_encrypt_matches_full_ladder():
+    """encrypt_ints_with_tables (truncated |v| ladder + conditional negate)
+    must equal the full-ladder encryption as GROUP elements for all int64,
+    including INT64_MIN where jnp.abs wraps."""
+    from drynx_tpu.crypto import curve as C
+
+    _, pub = eg.keygen(RNG)
+    ptab = eg.pub_table(pub)
+    vals = jnp.asarray([0, 5, -7, 2 ** 62, -(2 ** 63)], dtype=jnp.int64)
+    r = eg.random_scalars(jax.random.PRNGKey(8), (5,))
+    ct_new = eg.encrypt_ints_with_tables(
+        eg.BASE_TABLE.table, ptab.table, vals, r)
+    ct_old = eg.encrypt_with_tables(
+        eg.BASE_TABLE.table, ptab.table, eg.int_to_scalar(vals), r)
+    for comp in range(2):  # K and C components
+        ok = np.asarray(C.eq(ct_new[:, comp], ct_old[:, comp]))
+        assert ok.all(), (comp, ok)

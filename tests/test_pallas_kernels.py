@@ -1,0 +1,86 @@
+"""Pallas kernel parity vs the jnp curve layer (interpreter mode on CPU).
+
+On TPU these kernels are the dispatch target of curve.scalar_mul /
+elgamal.fixed_base_mul (crypto/pallas_ops.py); here they run through the
+Pallas interpreter so the kernel code paths are covered by the CPU suite."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# The full ladder kernels take many minutes to compile through the
+# interpreter on CPU; they are validated on real TPU by
+# scripts/pallas_probe.py. Opt in with DRYNX_PALLAS_INTERPRET_TESTS=1.
+heavy = pytest.mark.skipif(
+    os.environ.get("DRYNX_PALLAS_INTERPRET_TESTS", "0") != "1",
+    reason="ladder-kernel interpret compile is minutes-slow on CPU; "
+           "covered on hardware by scripts/pallas_probe.py")
+
+from drynx_tpu.crypto import curve as C
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.crypto import field as F
+from drynx_tpu.crypto import pallas_ops as po
+from drynx_tpu.crypto import params, refimpl
+
+RNG = np.random.default_rng(17)
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(po, "INTERPRET", True)
+
+
+def _rand_points(n):
+    ks = [int.from_bytes(RNG.bytes(32), "little") % params.N
+          for _ in range(n)]
+    pts = [refimpl.g1_mul(refimpl.G1, k) for k in ks]
+    return jnp.asarray(C.from_ref_batch(pts)), pts
+
+
+def _rand_scalars(n):
+    ss = [int.from_bytes(RNG.bytes(32), "little") % params.N
+          for _ in range(n)]
+    return jnp.asarray(F.from_int(ss)), ss
+
+
+def _assert_points_equal(a, b):
+    ax, ay, ai = C.normalize(a)
+    bx, by, bi = C.normalize(b)
+    assert bool(jnp.all(ai == bi))
+    fin = ~np.asarray(ai)
+    assert bool(np.all(np.asarray(ax)[fin] == np.asarray(bx)[fin]))
+    assert bool(np.all(np.asarray(ay)[fin] == np.asarray(by)[fin]))
+
+
+@heavy
+def test_scalar_mul_kernel_matches_jnp():
+    n = 4
+    p, _ = _rand_points(n)
+    k, _ = _rand_scalars(n)
+    k = k.at[0].set(0)  # edge: zero scalar -> infinity
+    out_pallas = po.scalar_mul_flat(p, k)
+    out_jnp = C._scalar_mul_jnp(p, k)
+    _assert_points_equal(out_pallas, out_jnp)
+
+
+@heavy
+def test_fixed_base_kernel_matches_jnp():
+    n = 5
+    k, ss = _rand_scalars(n)
+    out_pallas = po.fixed_base_mul_flat(eg.BASE_TABLE.table, k)
+    out_jnp = eg._fixed_base_mul_jnp(eg.BASE_TABLE.table, k)
+    _assert_points_equal(out_pallas, out_jnp)
+    assert C.to_ref(out_pallas[1]) == refimpl.g1_mul(refimpl.G1, ss[1])
+
+
+def test_point_add_and_reduce_kernels():
+    n = 3
+    p, _ = _rand_points(n)
+    q, _ = _rand_points(n)
+    _assert_points_equal(po.point_add_flat(p, q), C.add(p, q))
+
+    stack = jnp.stack([p, q, C.neg(p)])       # (3, n, 3, 16)
+    want = C.add(C.add(p, q), C.neg(p))       # == q
+    _assert_points_equal(po.point_reduce_flat(stack), want)
