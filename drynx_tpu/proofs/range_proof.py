@@ -253,6 +253,12 @@ def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int):
     A_tab (ns, u, 3, 2, 16); ca_tbl: collective-key fixed-base table.
     """
     from ..crypto import batching as B
+    from ..crypto import pallas_ops as po
+
+    # On the (tunneled) TPU backend, enqueueing this whole chain of large
+    # programs asynchronously has crashed the worker ("kernel fault"); the
+    # same ops run reliably with a sync between stages. No-op elsewhere.
+    sync = jax.block_until_ready if po.available() else (lambda x: x)
 
     base_tbl = eg.BASE_TABLE.table
     upow_m = _upow_mont(u, l)
@@ -271,16 +277,21 @@ def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int):
     zphi = B.fn_sub(s, B.fn_mul_plain(c_l, phi))
     zr = B.fn_sub(m_tot, B.fn_mul_plain(c, rs))
 
+    sync(D)
+
     # V_ij = v_ij · A_i[φ_j]  — gather digit signatures, blind in G2
     A_sel = A_tab[:, digits]                               # (ns, V, l, 3, 2, 16)
     V_pts = B.g2_scalar_mul(A_sel, v)
+    sync(V_pts)
 
     # a_ij = e(−s_j·B, V_ij) · gtB^{t_j}
     neg_s = B.fn_neg(s)
     nsB = B.fixed_base_mul(base_tbl, neg_s)                # (V, l, 3, 16)
     px, py, _ = B.g1_normalize(nsB)
     qx, qy, _ = B.g2_normalize(V_pts)
+    sync(qx)
     gt1 = B.pair(px, py, qx, qy)                           # (ns, V, l, 6, 2, 16)
+    sync(gt1)
     gt2 = B.gt_pow(gt_base(), t)                           # (V, l, 6, 2, 16)
     a = B.gt_mul(gt1, gt2)
 
@@ -327,6 +338,9 @@ def _verify_kernel(commit, c, zr, d, zphi, zv, v_pts, a, ys, ca_tbl,
                    u: int, l: int):
     """Batched verification. ys: (ns, 3, 16) server publics. Returns (V,)."""
     from ..crypto import batching as B
+    from ..crypto import pallas_ops as po
+
+    sync = jax.block_until_ready if po.available() else (lambda x: x)
 
     base_tbl = eg.BASE_TABLE.table
     upow_m = _upow_mont(u, l)
@@ -338,6 +352,7 @@ def _verify_kernel(commit, c, zr, d, zphi, zv, v_pts, a, ys, ca_tbl,
                   B.g1_add(B.fixed_base_mul(ca_tbl, zr),
                            B.fixed_base_mul(base_tbl, wz)))
     d_ok = B.g1_eq(Dp, d)                                  # (V,)
+    sync(d_ok)
 
     # a'_ij = e(c·y_i − Zphi_j·B, V_ij) · gtB^{Zv_ij}  (:538-546)
     cy = B.g1_scalar_mul(ys[:, None, :, :], c[None, :, :])  # (ns, V, 3, 16)
@@ -345,7 +360,9 @@ def _verify_kernel(commit, c, zr, d, zphi, zv, v_pts, a, ys, ca_tbl,
     g1arg = B.g1_add(cy[:, :, None, :, :], nzphiB[None])   # (ns, V, l, 3, 16)
     px, py, _ = B.g1_normalize(g1arg)
     qx, qy, _ = B.g2_normalize(v_pts)
+    sync(qx)
     gt1 = B.pair(px, py, qx, qy)
+    sync(gt1)
     ap = B.gt_mul(gt1, B.gt_pow(gt_base(), zv))
     a_ok = jnp.all(F12.eq(ap, a), axis=(0, -1))            # (V,)
 
