@@ -121,6 +121,16 @@ def cmd_survey_run(args) -> int:
     roster = Roster(entries)
     client = RemoteClient(roster)
     client.broadcast_roster()
+    if sv.get("proofs"):
+        result, block = client.run_survey(
+            op, query_min=qmin, query_max=qmax, proofs=True,
+            obfuscation=bool(sv.get("obfuscation", False)))
+        bitmap = block.get("bitmap", {})
+        print(json.dumps({"operation": op, "result": _jsonable(result),
+                          "block_hash": block.get("block_hash"),
+                          "bitmap_ok": bool(bitmap) and
+                          all(v == 1 for v in bitmap.values())}))
+        return 0
     result = client.run_survey(op, query_min=qmin, query_max=qmax)
     print(json.dumps({"operation": op, "result": _jsonable(result)}))
     return 0

@@ -46,7 +46,8 @@ def cmd_run(args) -> int:
     node = DrynxNode(cfg["name"], int(cfg["secret"], 16),
                      (int(cfg["public_x"], 16), int(cfg["public_y"], 16)),
                      host=cfg.get("host", "127.0.0.1"),
-                     port=int(cfg.get("port", 0)), data=data)
+                     port=int(cfg.get("port", 0)), data=data,
+                     db_path=args.db)
     print(f"drynx node {cfg['name']} listening on "
           f"{node.address[0]}:{node.address[1]}", file=sys.stderr, flush=True)
     try:
@@ -66,6 +67,8 @@ def main(argv=None) -> int:
     r = sub.add_parser("run", help="run node from config TOML on stdin")
     r.add_argument("--data", default=None,
                    help="path to this DP's local data (one int per line)")
+    r.add_argument("--db", default=None,
+                   help="proof/skipchain DB path (VN role)")
     r.set_defaults(fn=cmd_run)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -106,8 +106,10 @@ def _add_step(T, P_aff, xq, yq):
 _N_BITS = np.asarray([int(b) for b in bin(N)[3:]], dtype=np.uint32)  # MSB-first, skip top bit
 
 
-def miller_loop(p_aff, q_aff):
-    """f_{n,P}(Q). p_aff: (xp, yp) Fp Montgomery limb tensors (..., 16);
+def miller_loop_tate(p_aff, q_aff):
+    """f_{n,P}(Q) (Tate loop; kept as a cross-check — production pairing is
+    the optimal ate `miller_loop`, 65 steps instead of 255).
+    p_aff: (xp, yp) Fp Montgomery limb tensors (..., 16);
     q_aff: (xq, yq) Fp2 Montgomery tensors (..., 2, 16). Batched."""
     xp, yp = p_aff
     xq, yq = q_aff
@@ -139,7 +141,177 @@ def miller_loop(p_aff, q_aff):
     return f
 
 
-_EASY_DONE_EXP = (P**4 - P**2 + 1) // N  # hard part of the final exponent
+# ---------------------------------------------------------------------------
+# Optimal ate Miller loop (production): loop over 6u+2 (66 bits -> 65 steps)
+# with T on the twist E'(Fp2) and lines evaluated at P in G1, plus the two
+# Frobenius correction additions. Line sparsity is w-slots {0, 1, 3}:
+#   l(P) = yp*c0 + xp*c1 w + c3 w^3   (ci in Fp2)
+# (untwist x ~ w^2, y ~ w^3 puts the slope term on w). Any global Fp2 factor
+# of a line dies in the final exponentiation, so lines are denominator-free.
+# ---------------------------------------------------------------------------
+
+_ATE_BITS = np.asarray([int(b) for b in bin(6 * params.U + 2)[3:]],
+                       dtype=np.uint32)
+
+
+def _sparse_mul013(f, l0, l1, l3):
+    """f * (l0 + l1 w + l3 w^3); l0/l1/l3 are Fp2 tensors (..., 2, 16)."""
+    out = [None] * 6
+    acc = [None] * 9
+
+    def accum(k, v):
+        acc[k] = v if acc[k] is None else F2.add(acc[k], v)
+
+    for k in range(6):
+        fk = f[..., k, :, :]
+        accum(k, F2.mul(fk, l0))
+        accum(k + 1, F2.mul(fk, l1))
+        accum(k + 3, F2.mul(fk, l3))
+    for k in range(6):
+        out[k] = acc[k]
+    for k in range(6, 9):
+        out[k - 6] = F2.add(out[k - 6], F2.mul_xi(acc[k]))
+    return jnp.stack(out, axis=-3)
+
+
+def _ate_dbl_step(T, xp, yp):
+    """Tangent line at Jacobian twist point T evaluated at P, then T <- 2T.
+
+    Scaled by 2YZ^3 (an Fp2 factor, killed by FE):
+    l = 2YZ^3 yp - 3X^2 Z^2 xp w + (3X^3 - 2Y^2) w^3.
+    (Same polynomials as the Tate _dbl_step with the w-roles mirrored.)
+    """
+    from . import g2 as G2m
+
+    X, Y, Z = T[..., 0, :, :], T[..., 1, :, :], T[..., 2, :, :]
+    X2 = F2.sqr(X)
+    Y2 = F2.sqr(Y)
+    Z2 = F2.sqr(Z)
+    X3 = F2.mul(X2, X)
+    threeX2 = F2.add(F2.add(X2, X2), X2)
+    l3 = F2.sub(F2.add(F2.add(X3, X3), X3), F2.add(Y2, Y2))
+    l1 = F2.mul_fp(F2.neg(F2.mul(threeX2, Z2)), xp)
+    YZ3 = F2.mul(Y, F2.mul(Z, Z2))
+    l0 = F2.mul_fp(F2.add(YZ3, YZ3), yp)
+    return G2m.double(T), l0, l1, l3
+
+
+def _ate_add_step(T, q_aff, xp, yp):
+    """Line through T and affine twist Q evaluated at P, plus T <- T+Q and
+    the vertical-degeneracy flag. With H = X - xq Z^2, M = Y - yq Z^3:
+    l = HZ yp - M xp w + (M xq - HZ yq) w^3   (scaled by HZ)."""
+    from . import g2 as G2m
+
+    X, Y, Z = T[..., 0, :, :], T[..., 1, :, :], T[..., 2, :, :]
+    xq, yq = q_aff
+    Z2 = F2.sqr(Z)
+    H = F2.sub(X, F2.mul(xq, Z2))
+    M = F2.sub(Y, F2.mul(yq, F2.mul(Z, Z2)))
+    HZ = F2.mul(H, Z)
+    l0 = F2.mul_fp(HZ, yp)
+    l1 = F2.mul_fp(F2.neg(M), xp)
+    l3 = F2.sub(F2.mul(M, xq), F2.mul(HZ, yq))
+    degen = F2.is_zero(H)
+    one2 = jnp.broadcast_to(F2.one(), xq.shape)
+    Q_jac = jnp.stack([xq, yq, one2], axis=-3)
+    return G2m.add(T, Q_jac), l0, l1, l3, degen
+
+
+# G2 Frobenius constants (device copies of the oracle's, refimpl.twist_frob).
+_G12_DEV = None
+_G13_DEV = None
+_G22_DEV = None
+
+
+def _twist_frob_consts():
+    global _G12_DEV, _G13_DEV, _G22_DEV
+    if _G12_DEV is None:
+        _G12_DEV = jnp.asarray(F2.from_ref(refimpl._G12))
+        _G13_DEV = jnp.asarray(F2.from_ref(refimpl._G13))
+        _G22_DEV = jnp.asarray(F2.from_ref(refimpl._G22))
+    return _G12_DEV, _G13_DEV, _G22_DEV
+
+
+def miller_loop(p_aff, q_aff):
+    """Optimal ate Miller function
+    f_{6u+2,Q}(P) * l_{[6u+2]Q,piQ}(P) * l_{[6u+2]Q+piQ,-pi2Q}(P), batched.
+    p_aff: (xp, yp) Fp Montgomery limb tensors (..., 16);
+    q_aff: (xq, yq) Fp2 Montgomery tensors (..., 2, 16)."""
+    xp, yp = p_aff
+    xq, yq = q_aff
+    batch = jnp.broadcast_shapes(xp.shape[:-1], xq.shape[:-2])
+    xp = jnp.broadcast_to(xp, batch + (NUM_LIMBS,))
+    yp = jnp.broadcast_to(yp, batch + (NUM_LIMBS,))
+    xq = jnp.broadcast_to(xq, batch + (2, NUM_LIMBS))
+    yq = jnp.broadcast_to(yq, batch + (2, NUM_LIMBS))
+
+    one2 = jnp.broadcast_to(F2.one(), xq.shape)
+    T0 = jnp.stack([xq, yq, one2], axis=-3)
+    f0 = F12.one(batch)
+    bits = jnp.asarray(_ATE_BITS)
+
+    def step(state, bit):
+        T, f = state
+        f = F12.sqr(f)
+        T2, l0, l1, l3 = _ate_dbl_step(T, xp, yp)
+        f = _sparse_mul013(f, l0, l1, l3)
+        T = T2
+        Ta, a0, a1, a3, degen = _ate_add_step(T, (xq, yq), xp, yp)
+        fa = _sparse_mul013(f, a0, a1, a3)
+        fa = jnp.where(degen[..., None, None, None], f, fa)
+        f = jnp.where(bit == 1, fa, f)
+        T = jnp.where(bit == 1, Ta, T)
+        return (T, f), None
+
+    (T, f), _ = jax.lax.scan(step, (T0, f0), bits)
+
+    # Frobenius corrections: Q1 = pi(Q); -pi^2(Q) = (xq*g22, yq) because
+    # XI^((p^2-1)/2) = -1 (XI is a non-square in Fp2).
+    g12, g13, g22 = _twist_frob_consts()
+    q1 = (F2.mul(F2.conj(xq), g12), F2.mul(F2.conj(yq), g13))
+    Ta, a0, a1, a3, degen = _ate_add_step(T, q1, xp, yp)
+    fa = _sparse_mul013(f, a0, a1, a3)
+    f = jnp.where(degen[..., None, None, None], f, fa)
+    T = jnp.where(degen[..., None, None, None], T, Ta)
+
+    nq2 = (F2.mul(xq, g22), yq)
+    _, a0, a1, a3, degen = _ate_add_step(T, nq2, xp, yp)
+    fa = _sparse_mul013(f, a0, a1, a3)
+    f = jnp.where(degen[..., None, None, None], f, fa)
+    return f
+
+
+# Devegili–Scott–Dominguez decomposition of the hard part (verified exactly
+# for this curve's u in tests/test_pairing.py):
+#   (p^4-p^2+1)/n = p^3 + (6u^2+1)p^2 + (-36u^3-18u^2-12u+1)p
+#                   + (-36u^3-30u^2-18u-2)
+# evaluated with 3 exponentiations by u (63 bits) + Frobenius + ~13 muls via
+# the Olivos vectorial addition chain — replaces the former ~1016-bit
+# static-exponent scan (the round-1 perf TODO; reference cost center is
+# lib/range/range_proof.go:504-565 pairing verification).
+def _hard_part(f):
+    """f^((p^4-p^2+1)/n) for f in the cyclotomic subgroup (inverse=conj6)."""
+    mul, sqr, conj = F12.mul, F12.sqr, F12.conj6
+    fx = F12.pow_const(f, params.U)
+    fx2 = F12.pow_const(fx, params.U)
+    fx3 = F12.pow_const(fx2, params.U)
+    y0 = mul(mul(_frob1(f), _frob2(f)), _frob3(f))
+    y1 = conj(f)
+    y2 = _frob2(fx2)
+    y3 = conj(_frob1(fx))
+    y4 = conj(mul(fx, _frob1(fx2)))
+    y5 = conj(fx2)
+    y6 = conj(mul(fx3, _frob1(fx3)))
+    # Olivos chain for y0 * y1^2 * y2^6 * y3^12 * y4^18 * y5^30 * y6^36
+    t0 = mul(mul(sqr(y6), y4), y5)
+    t1 = mul(mul(y3, y5), t0)
+    t0 = mul(t0, y2)
+    t1 = mul(sqr(t1), t0)
+    t1 = sqr(t1)
+    t0 = mul(t1, y1)
+    t1 = mul(t1, y0)
+    t0 = sqr(t0)
+    return mul(t0, t1)
 
 
 def final_exp(f):
@@ -148,7 +320,7 @@ def final_exp(f):
     f1 = F12.mul(F12.conj6(f), F12.inv(f))
     # f^(p^2+1) = frob^2(f) * f; frob^2 on our flat tower: c_k -> c_k * g2^k
     f2 = F12.mul(_frob2(f1), f1)
-    return F12.pow_const(f2, _EASY_DONE_EXP)
+    return _hard_part(f2)
 
 
 # Frobenius^2 constants: w^(p^2) = w * g2 with g2 = XI^((p^2-1)/6) in Fp2
@@ -173,10 +345,37 @@ def _frob2(f):
     return jnp.stack(out, axis=-3)
 
 
+# Odd Frobenius powers conjugate the Fp2 coefficients (p = 3 mod 4, so
+# i^p = -i and likewise p^3 = 3 mod 4): f^(p^e) = sum conj(c_k) g_e^k w^k
+# with g_e = w^(p^e - 1) = XI^((p^e-1)/6) in Fp2.
+def _frob_odd_consts(e: int):
+    assert (P**e - 1) % 6 == 0
+    g = refimpl.fp2_pow(params.XI, (P**e - 1) // 6)
+    consts, cur = [], (1, 0)
+    for _k in range(6):
+        consts.append(F2.from_ref(cur))
+        cur = refimpl.fp2_mul(cur, g)
+    return jnp.asarray(np.stack(consts))
+
+
+_FROB1 = _frob_odd_consts(1)
+_FROB3 = _frob_odd_consts(3)
+
+
+def _frob1(f):
+    out = [F2.mul(F2.conj(f[..., k, :, :]), _FROB1[k]) for k in range(6)]
+    return jnp.stack(out, axis=-3)
+
+
+def _frob3(f):
+    out = [F2.mul(F2.conj(f[..., k, :, :]), _FROB3[k]) for k in range(6)]
+    return jnp.stack(out, axis=-3)
+
+
 def pair(p_aff, q_aff):
     """Reduced Tate pairing, batched. Infinity handling is the caller's
     concern (use select against F12.one())."""
     return final_exp(miller_loop(p_aff, q_aff))
 
 
-__all__ = ["miller_loop", "final_exp", "pair"]
+__all__ = ["miller_loop", "miller_loop_tate", "final_exp", "pair"]

@@ -344,11 +344,86 @@ def final_exp(f):
     return fp12_pow(f, params.FINAL_EXP)
 
 
-def pair(p1, q2):
+def pair_tate(p1, q2):
     """Reduced Tate pairing e(P, Q); P in G1, Q in G2 (twist coords)."""
     if p1 is None or q2 is None:
         return FP12_ONE
     return final_exp(miller_loop(p1, q2))
+
+
+# ---------------------------------------------------------------------------
+# Optimal ate pairing (the production pairing; loop length 6u+2 — 65 steps
+# instead of the 255-bit Tate loop). Both are non-degenerate bilinear maps
+# G1 x G2 -> mu_n; the whole stack (BB signatures, range proofs) only needs
+# bilinearity + consistency, so device and oracle both use the ate variant.
+# ---------------------------------------------------------------------------
+
+ATE_LOOP = 6 * params.U + 2
+
+# G2 Frobenius constants: untwist (x,y)->(x w^2, y w^3); w^(p-1) = XI^((p-1)/6).
+_G12 = fp2_pow(params.XI, (params.P - 1) // 3)   # acts on x
+_G13 = fp2_pow(params.XI, (params.P - 1) // 2)   # acts on y
+_G22 = fp2_pow(params.XI, (params.P * params.P - 1) // 3)
+# XI is a non-square in Fp2, so XI^((p^2-1)/2) = -1: -pi^2(Q) = (x*G22, y).
+
+
+def twist_frob(q):
+    """pi(x, y) = (conj(x)*XI^((p-1)/3), conj(y)*XI^((p-1)/2)) on the twist."""
+    x, y = q
+    return (fp2_mul((x[0], (-x[1]) % P), _G12),
+            fp2_mul((y[0], (-y[1]) % P), _G13))
+
+
+def _ate_line(t, q, p_aff, tangent):
+    """Line through twist points t (and q, or tangent at t), evaluated at
+    untwisted coordinates of P in G1: l = yp - lam*xp*w + (lam*xt - yt)*w^3."""
+    xt, yt = t
+    xp, yp = p_aff
+    if tangent:
+        lam = fp2_mul(fp2_muls(fp2_sq(xt), 3), fp2_inv(fp2_muls(yt, 2)))
+    else:
+        xq, yq = q
+        if xt == xq:
+            return None  # vertical: contributes an Fp2 factor, dies in FE
+        lam = fp2_mul(fp2_sub(yt, yq), fp2_inv(fp2_sub(xt, xq)))
+    out = [FP2_ZERO] * 6
+    out[0] = (yp % P, 0)
+    out[1] = fp2_muls(lam, (-xp) % P)
+    out[3] = fp2_sub(fp2_mul(lam, xt), yt)
+    return tuple(out)
+
+
+def ate_miller_loop(p1, q2):
+    """f_{6u+2,Q}(P) * l_{[6u+2]Q,pi(Q)}(P) * l_{[6u+2]Q+pi(Q),-pi^2(Q)}(P)."""
+    t = q2
+    f = FP12_ONE
+    for bit in bin(ATE_LOOP)[3:]:
+        f = fp12_sq(f)
+        line = _ate_line(t, None, p1, tangent=True)
+        f = fp12_mul(f, line)
+        t = g2_add(t, t)
+        if bit == "1":
+            line = _ate_line(t, q2, p1, tangent=False)
+            if line is not None:
+                f = fp12_mul(f, line)
+            t = g2_add(t, q2)
+    q1 = twist_frob(q2)
+    neg_q2 = (fp2_mul(q2[0], _G22), q2[1])
+    line = _ate_line(t, q1, p1, tangent=False)
+    if line is not None:
+        f = fp12_mul(f, line)
+    t = g2_add(t, q1)
+    line = _ate_line(t, neg_q2, p1, tangent=False)
+    if line is not None:
+        f = fp12_mul(f, line)
+    return f
+
+
+def pair(p1, q2):
+    """Reduced optimal ate pairing e(P, Q); P in G1, Q in G2 (twist coords)."""
+    if p1 is None or q2 is None:
+        return FP12_ONE
+    return final_exp(ate_miller_loop(p1, q2))
 
 
 __all__ = [
@@ -359,5 +434,6 @@ __all__ = [
     "FP12_ONE", "FP12_ZERO",
     "g1_is_on_curve", "g1_neg", "g1_add", "g1_mul", "G1",
     "g2_is_on_curve", "g2_neg", "g2_add", "g2_mul", "G2",
-    "untwist", "miller_loop", "final_exp", "pair",
+    "untwist", "miller_loop", "final_exp", "pair", "pair_tate",
+    "ate_miller_loop", "twist_frob", "ATE_LOOP",
 ]

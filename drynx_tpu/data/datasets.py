@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import zlib
 
 import numpy as np
 
@@ -38,7 +39,10 @@ def generate(name: str, seed: int = 0):
     linear-logit model so encrypted training has signal to find."""
     spec = SHAPES[name]
     n, d = spec["n"], spec["d"]
-    rng = np.random.default_rng(seed + hash(name) % (2 ** 16))
+    # crc32, not hash(): str hash is PYTHONHASHSEED-randomized per process,
+    # so (name, seed) must map to the same stream in every process (two DPs
+    # "generating the same dataset" have to agree).
+    rng = np.random.default_rng(seed + zlib.crc32(name.encode()) % (2 ** 16))
     scales = rng.uniform(1.0, 30.0, size=d)
     offsets = rng.uniform(0.0, 50.0, size=d)
     X = np.abs(rng.normal(size=(n, d))) * scales + offsets

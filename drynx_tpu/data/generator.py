@@ -39,8 +39,12 @@ def create_random_good_test_data(cluster, n_values: int = 2, u: int = 4,
     vals = rng.integers(0, u ** l, size=(n_values,)).astype(np.int64)
     key, k1, k2 = jax.random.split(key, 3)
     cts, rs = eg.encrypt_ints(k1, cluster.coll_tbl, vals)
-    out["range"] = rproof.create_range_proofs(
-        k2, vals, rs, cts, sigs, u, l, cluster.coll_tbl.table).to_bytes()
+    out["range"] = rproof.RangeProofList(
+        n_values=n_values,
+        batches=[(np.arange(n_values, dtype=np.int64),
+                  rproof.create_range_proofs(
+                      k2, vals, rs, cts, sigs, u, l,
+                      cluster.coll_tbl.table))]).to_bytes()
 
     # aggregation
     key, k3 = jax.random.split(key)

@@ -142,6 +142,136 @@ def encode_clear(op: str, data, query_min: int = 0, query_max: int = 0,
 
 
 # ---------------------------------------------------------------------------
+# Group-by encoders (reference data_collection_protocol.go:157-196: DPs
+# encode PER GROUP-BY VALUE and the root adds same-group responses).
+#
+# TPU-first formulation: the group becomes a leading tensor axis. The static
+# group grid (cartesian product of candidate values, reference
+# AllPossibleGroups) is known from the query, so every group's statistics are
+# computed in ONE pass via a (n_groups, rows) membership mask — no ragged
+# per-group subsets, fully jit/vmap-safe. Aggregation then needs no
+# "same-group matching" at all: element-wise homomorphic addition along the
+# aligned group axis IS the per-group aggregation.
+# ---------------------------------------------------------------------------
+
+def group_grid(group_by) -> np.ndarray:
+    """Cartesian product of candidate values per group attribute
+    (reference AllPossibleGroups): [[vals_attr0], [vals_attr1], ...]
+    -> int64 (n_groups, n_attrs)."""
+    arrs = [np.asarray(v, dtype=np.int64) for v in group_by]
+    mesh = np.meshgrid(*arrs, indexing="ij")
+    return np.stack([m.ravel() for m in mesh], axis=-1)
+
+
+def encode_clear_grouped(op: str, data, groups, grid, query_min: int = 0,
+                         query_max: int = 0, preds=None, bit_scale=None):
+    """Per-group local sufficient statistics: (n_groups, V).
+
+    data: as encode_clear. groups: int64 (rows, n_attrs) group label per
+    record. grid: int64 (n_groups, n_attrs) from group_grid(). Empty groups
+    encode the operation's identity (0 contributions / empty-set bits).
+    """
+    x = jnp.asarray(data, dtype=jnp.int64)
+    g = jnp.asarray(groups, dtype=jnp.int64)
+    gr = jnp.asarray(grid, dtype=jnp.int64)
+    s = jnp.int64(1) if bit_scale is None else jnp.asarray(bit_scale, jnp.int64)
+    # (n_groups, rows) membership mask
+    mask = jnp.all(g[None, :, :] == gr[:, None, :], axis=-1)
+    mi = mask.astype(jnp.int64)
+
+    if op == "sum":
+        return (mi @ x)[:, None]
+    if op == "mean":
+        return jnp.stack([mi @ x, mi.sum(axis=1)], axis=1)
+    if op == "variance":
+        return jnp.stack([mi @ x, mi.sum(axis=1), mi @ (x * x)], axis=1)
+    if op == "cosim":
+        a, b = x[:, 0], x[:, 1]
+        return jnp.stack([mi @ a, mi @ b, mi @ (a * a), mi @ (b * b),
+                          mi @ (a * b)], axis=1)
+    if op == "bool_OR":
+        bit = jnp.any(mask & (x != 0)[None, :], axis=1).astype(jnp.int64)
+        return (bit * s)[:, None]
+    if op == "bool_AND":
+        # complement bit; empty group = AND over empty set = true -> encode 0
+        bit = jnp.all(jnp.where(mask, x != 0, True), axis=1).astype(jnp.int64)
+        return ((1 - bit) * s)[:, None]
+    if op == "min":
+        # empty-group sentinel max+1 -> all bits 0 (contributes nothing to OR)
+        local = jnp.min(jnp.where(mask, x[None, :], query_max + 1), axis=1)
+        grid_v = jnp.arange(query_min, query_max + 1, dtype=jnp.int64)
+        return (grid_v[None, :] >= local[:, None]).astype(jnp.int64) * s
+    if op == "max":
+        # empty-group sentinel min-1 -> bits all 1 -> complement 0
+        local = jnp.max(jnp.where(mask, x[None, :], query_min - 1), axis=1)
+        grid_v = jnp.arange(query_min, query_max + 1, dtype=jnp.int64)
+        bits = (grid_v[None, :] >= local[:, None]).astype(jnp.int64)
+        return (1 - bits) * s
+    if op == "frequency_count":
+        grid_v = jnp.arange(query_min, query_max + 1, dtype=jnp.int64)
+        eq = (x[:, None] == grid_v[None, :]).astype(jnp.int64)
+        return mi @ eq
+    if op == "union":
+        grid_v = jnp.arange(query_min, query_max + 1, dtype=jnp.int64)
+        pres = jnp.any(mask[:, :, None] & (x[:, None] == grid_v)[None],
+                       axis=1).astype(jnp.int64)
+        return pres * s
+    if op == "inter":
+        grid_v = jnp.arange(query_min, query_max + 1, dtype=jnp.int64)
+        pres = jnp.any(mask[:, :, None] & (x[:, None] == grid_v)[None],
+                       axis=1).astype(jnp.int64)
+        return (1 - pres) * s
+    if op == "lin_reg":
+        X, y = x[:, :-1], x[:, -1]
+        d = X.shape[1]
+        n = mi.sum(axis=1)
+        sx = mi @ X
+        outer = jnp.einsum("gr,rd,re->gde", mi, X, X)
+        iu, ju = np.triu_indices(d)
+        sxx = outer[:, iu, ju]
+        sy = (mi @ y)[:, None]
+        sxy = jnp.einsum("gr,r,rd->gd", mi, y, X)
+        return jnp.concatenate([n[:, None], sx, sxx, sy, sxy], axis=1)
+    if op == "r2":
+        y = x
+        p = jnp.asarray(preds, dtype=jnp.int64)
+        err = p - y
+        return jnp.stack([mi.sum(axis=1), mi @ y, mi @ (y * y),
+                          mi @ (err * err)], axis=1)
+    raise ValueError(f"unknown operation {op!r} for grouped encoding")
+
+
+def decode_grouped(op: str, dec: DecryptedVector, grid, query_min: int = 0,
+                   query_max: int = 0, dims: int = 1) -> dict:
+    """Per-group decode (reference services/api.go:124-128): the decrypted
+    vector is (n_groups * V,) group-major; returns {group_tuple: result}.
+
+    Groups with no data decode to None where the op can express that (mean /
+    variance / cosim / r2 / lin_reg have an N component; min's all-zero OR
+    bits yield None). `max` is the exception: its AND-complement encoding's
+    aggregation-neutral element equals a genuine max of query_min, so an
+    all-empty group decodes to query_min — the same ambiguity exists in the
+    reference's bit encoding (min_max.go:87-123)."""
+    grid = np.asarray(grid)
+    n_groups = grid.shape[0]
+    v = np.asarray(dec.values).reshape(n_groups, -1)
+    f = np.asarray(dec.found).reshape(n_groups, -1)
+    z = np.asarray(dec.is_zero).reshape(n_groups, -1)
+    out = {}
+    for gi in range(n_groups):
+        sub = DecryptedVector(values=v[gi], found=f[gi], is_zero=z[gi])
+        if op == "cosim" and int(v[gi][2]) * int(v[gi][3]) == 0:
+            out[tuple(int(t) for t in grid[gi])] = None  # empty/degenerate
+            continue
+        try:
+            r = decode(op, sub, query_min, query_max, dims)
+        except ZeroDivisionError:
+            r = None  # empty group: mean/variance/r2 undefined
+        out[tuple(int(t) for t in grid[gi])] = r
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Decoders (host-side; exact rational arithmetic where the reference is exact)
 # ---------------------------------------------------------------------------
 
@@ -239,4 +369,5 @@ def _decode_linreg(v: np.ndarray, d: int):
 OPS = ["sum", "mean", "variance", "cosim", "bool_OR", "bool_AND", "min",
        "max", "frequency_count", "union", "inter", "lin_reg", "r2"]
 
-__all__ = ["OPS", "DecryptedVector", "encode_clear", "decode", "output_size"]
+__all__ = ["OPS", "DecryptedVector", "encode_clear", "decode", "output_size",
+           "group_grid", "encode_clear_grouped", "decode_grouped"]

@@ -134,10 +134,13 @@ def make_inputs(X, y, params: lr.LRParams, num_dps: int = 10, seed: int = 0):
 def pima_shaped_problem(num_dps: int = 10, n_records: int = 768, d: int = 8,
                         max_iterations: int = 450):
     """Pima-benchmark-shaped problem (reference TIFS/logRegV2.py setting:
-    768 records x 10 DPs, 8 features, K=2, 450 iterations)."""
-    X, y = lr.synthetic_dataset(n=n_records, d=d, seed=13)
-    X = np.tile(X, (num_dps, 1))
-    y = np.tile(y, num_dps)
+    768 records x 10 DPs, 8 features, K=2, 450 iterations).
+
+    Every DP gets DISTINCT rows: one pool of num_dps*n_records records is
+    row-sharded i % num_dps (reference GetDataForDataProvider,
+    logistic_regression.go:1427-1443) — n_records rows PER DP, i.e. 10x the
+    reference's per-DP load, and no two DPs hold the same data."""
+    X, y = lr.synthetic_dataset(n=n_records * num_dps, d=d, seed=13)
     p = lr.LRParams(
         k=2, precision=1.0, lambda_=1.0, step=0.1,
         max_iterations=max_iterations, n_features=d,

@@ -212,6 +212,8 @@ def predict(X, w, means=None, std_devs=None, threshold=0.5):
 def fold_affine(w, means=None, std_devs=None):
     """Fold standardisation into the weight vector so it acts on RAW records:
     w·[(x−mu)/sd] + w0  ==  w'·x + w0'. Returns (w0', w' (d,))."""
+    if (means is None) != (std_devs is None):
+        raise ValueError("means and std_devs must be given together")
     w = np.asarray(w, dtype=np.float64)
     w0, wf = float(w[0]), w[1:]
     if means is None:

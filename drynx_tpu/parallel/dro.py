@@ -63,21 +63,56 @@ def encrypt_noise(key, pub_table: eg.FixedBase, noise: np.ndarray):
     return ct
 
 
-def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None):
+def precompute_rerandomization(key, pub_tbl, size: int, base_tbl=None):
+    """Precompute the expensive half of a shuffle step: `size` fresh
+    encryptions of zero (r·B, r·P) plus their scalars.
+
+    The reference caches exactly this per server across surveys
+    (`pre_compute_multiplications.gob`, services/service.go:34,316-317 +
+    unlynx PrecomputationWritingForShuffling) — it is what makes the
+    1M-element DRO noise lists survivable. Returns (zero_cts, r) usable as
+    the `precomp` argument of shuffle_rerandomize.
+    """
+    base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
+    r = eg.random_scalars(key, (size,))
+    zeros = jnp.zeros((size,), dtype=jnp.int64)
+    zero_ct = eg.encrypt_with_tables(base_tbl, pub_tbl,
+                                     eg.int_to_scalar(zeros), r)
+    return zero_ct, r
+
+
+def save_precompute(path: str, precomp) -> None:
+    """Persist a precomputation (the reference's gob-file equivalent)."""
+    zero_ct, r = precomp
+    np.savez(path, zero_ct=np.asarray(zero_ct), r=np.asarray(r))
+
+
+def load_precompute(path: str):
+    d = np.load(path)
+    return jnp.asarray(d["zero_ct"]), jnp.asarray(d["r"])
+
+
+def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None, precomp=None):
     """One server's DRO step: secret permutation + re-randomization.
 
     cts: (S, 2, 3, 16). Returns (shuffled cts, permutation, rerand scalars)
-    — the latter two feed the shuffle proof.
+    — the latter two feed the shuffle proof. `precomp` (from
+    precompute_rerandomization) skips the S fixed-base scalar-mults — the
+    hot cost at reference noise sizes (10k..1M, TIFS/diffPri.py).
     """
-    base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
     S = cts.shape[0]
     kperm, krand = jax.random.split(key)
     perm = jax.random.permutation(kperm, S)
     shuffled = jnp.take(cts, perm, axis=0)
-    r = eg.random_scalars(krand, (S,))
-    zeros = jnp.zeros((S,), dtype=jnp.int64)
-    zero_ct = eg.encrypt_with_tables(base_tbl, pub_tbl,
-                                     eg.int_to_scalar(zeros), r)
+    if precomp is not None:
+        zero_ct, r = precomp
+        assert zero_ct.shape[0] == S, (zero_ct.shape, S)
+    else:
+        base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
+        r = eg.random_scalars(krand, (S,))
+        zeros = jnp.zeros((S,), dtype=jnp.int64)
+        zero_ct = eg.encrypt_with_tables(base_tbl, pub_tbl,
+                                         eg.int_to_scalar(zeros), r)
     return eg.ct_add(shuffled, zero_ct), perm, r
 
 
@@ -96,4 +131,5 @@ def dro_pipeline(key, pub_tbl, size: int, mean: float, b: float,
 
 
 __all__ = ["generate_noise_values", "encrypt_noise", "shuffle_rerandomize",
+           "precompute_rerandomization", "save_precompute", "load_precompute",
            "dro_pipeline"]

@@ -391,26 +391,110 @@ def verify_range_proofs(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     return ok
 
 
-def verify_range_proof_list(proofs: list[RangeProofBatch], ranges,
-                            sigs_pub_per_value, ca_pub_table,
-                            threshold: float) -> bool:
-    """Threshold-sampled list verification (RangeProofListVerification :484):
-    verifies the first ceil(threshold·len) proofs."""
-    import math
+# ---------------------------------------------------------------------------
+# Mixed-range proof lists (per-value (u, l) specs)
+# ---------------------------------------------------------------------------
 
-    nbr = math.ceil(threshold * len(proofs))
-    for i in range(nbr):
-        u, l = ranges[i]
+
+@dataclasses.dataclass
+class RangeProofList:
+    """Per-DP proof payload for an output vector with PER-VALUE range specs
+    (reference creates/verifies each output with its own (u,l):
+    lib/range/range_proof.go:320-407, lib/structs.go:446-533). Values sharing
+    a spec are batched into one RangeProofBatch — the TPU grouping — with the
+    output indices each batch covers. Indices whose spec is (0,0) carry no
+    proof (reference: zero ranges mean 'unproved')."""
+
+    n_values: int
+    batches: list                      # [(int64 idx array, RangeProofBatch)]
+
+    def to_bytes(self) -> bytes:
+        head = np.asarray([self.n_values, len(self.batches)],
+                          dtype=np.int64).tobytes()
+        parts = [head]
+        for idx, pb in self.batches:
+            blob = pb.to_bytes()
+            idx = np.asarray(idx, dtype=np.int64)
+            parts.append(np.asarray([idx.size, len(blob)],
+                                    dtype=np.int64).tobytes())
+            parts.append(idx.tobytes())
+            parts.append(blob)
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes) -> "RangeProofList":
+        n_values, n_batches = np.frombuffer(buf[:16], dtype=np.int64)
+        off = 16
+        batches = []
+        for _ in range(int(n_batches)):
+            n_idx, n_blob = np.frombuffer(buf[off:off + 16], dtype=np.int64)
+            off += 16
+            idx = np.frombuffer(buf[off:off + 8 * int(n_idx)], dtype=np.int64)
+            off += 8 * int(n_idx)
+            pb = RangeProofBatch.from_bytes(buf[off:off + int(n_blob)])
+            off += int(n_blob)
+            batches.append((idx.copy(), pb))
+        return cls(n_values=int(n_values), batches=batches)
+
+
+def group_ranges(ranges) -> dict:
+    """{(u, l): [output indices]} for nonzero specs, insertion-ordered."""
+    spec_to_idx: dict = {}
+    for i, (u, l) in enumerate(ranges):
         if u == 0 and l == 0:
             continue
-        ok = verify_range_proofs(proofs[i], sigs_pub_per_value[i],
-                                 ca_pub_table)
-        if not bool(np.all(ok)):
+        spec_to_idx.setdefault((int(u), int(l)), []).append(i)
+    return spec_to_idx
+
+
+def create_range_proof_list(key, secrets, rs, cts, ranges,
+                            sigs_by_u: dict, ca_pub_table) -> RangeProofList:
+    """Create the per-DP mixed-range payload.
+
+    ranges: [(u, l)] per output index; sigs_by_u: {u: [RangeSig per CN]}.
+    """
+    secrets = np.asarray(secrets)
+    batches = []
+    for (u, l), idx in group_ranges(ranges).items():
+        key, sub = jax.random.split(key)
+        ia = np.asarray(idx, dtype=np.int64)
+        pb = create_range_proofs(
+            sub, secrets[ia], jnp.asarray(rs)[ia], jnp.asarray(cts)[ia],
+            sigs_by_u[u], u, l, ca_pub_table)
+        batches.append((ia, pb))
+    return RangeProofList(n_values=len(ranges), batches=batches)
+
+
+def verify_range_proof_list(lst: RangeProofList, ranges,
+                            sigs_pub_by_u: dict, ca_pub_table) -> bool:
+    """Verify a mixed-range payload against the QUERY's specs: every output
+    index with a nonzero (u, l) must be covered by exactly one batch carrying
+    that exact spec (a prover cannot substitute a looser range), and every
+    batch must verify."""
+    want = group_ranges(ranges)
+    covered = {}
+    for ia, pb in lst.batches:
+        for i in ia:
+            if int(i) in covered:
+                return False
+            covered[int(i)] = (pb.u, pb.l)
+    for (u, l), idx in want.items():
+        for i in idx:
+            if covered.get(i) != (u, l):
+                return False
+    if set(covered) != {i for idx in want.values() for i in idx}:
+        return False
+    for ia, pb in lst.batches:
+        pubs = sigs_pub_by_u.get(pb.u)
+        if pubs is None:
+            return False
+        if not bool(np.all(verify_range_proofs(pb, pubs, ca_pub_table))):
             return False
     return True
 
 
 __all__ = ["RangeSig", "init_range_sig", "to_base", "RangeProofBatch",
-           "create_range_proofs", "verify_range_proofs",
+           "RangeProofList", "group_ranges", "create_range_proofs",
+           "create_range_proof_list", "verify_range_proofs",
            "verify_range_proof_list", "challenge_for_commits", "gt_base",
            "sum_publics_bytes"]

@@ -78,16 +78,25 @@ def new_proof_request(proof_type: str, survey_id: str, sender_id: str,
 
 def verify_proof_request(req: ProofRequest, sender_pub,
                          sample: float,
-                         verify_payload: Optional[Callable[[bytes], bool]],
+                         verify_payload: Optional[Callable[[bytes, str], bool]],
                          rng: np.random.Generator) -> int:
     """VN-side verification -> bitmap code (reference VerifyProof family
     :135-492: signature check, then `rand.Float64() <= sample` gates the
-    payload verification)."""
+    payload verification). `verify_payload(data, survey_id)` — the survey id
+    lets the verifier fetch the query's expected parameters (e.g. per-value
+    range specs, lib/structs.go:446-533)."""
     if not schnorr.verify(sender_pub, req.signed_payload(), req.signature):
         return BM_BADSIG
     if verify_payload is None or float(rng.random()) > sample:
         return BM_RECVD
-    return BM_TRUE if verify_payload(req.data) else BM_FALSE
+    try:
+        ok = verify_payload(req.data, req.survey_id)
+    except Exception:
+        # a malformed/malicious payload is a FAILED verification, not a
+        # crash: the proof must still be counted so the survey's expected-
+        # proof counter drains and the (dirty) audit block can commit
+        ok = False
+    return BM_TRUE if ok else BM_FALSE
 
 
 __all__ = ["BM_FALSE", "BM_TRUE", "BM_RECVD", "BM_BADSIG", "PROOF_TYPES",

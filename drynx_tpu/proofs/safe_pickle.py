@@ -1,0 +1,45 @@
+"""Restricted unpickling for network-received proof payloads.
+
+VNs deserialize proof bodies sent by the very parties they exist to distrust
+(reference threat model: malicious DPs/CNs caught by ZK proofs). A plain
+`pickle.loads` on attacker-controlled bytes is remote code execution — a
+crafted `__reduce__` payload runs arbitrary callables during load. This
+module allows only the value types proofs legitimately contain: numpy / jax
+array machinery and the proof dataclasses of this package.
+
+(The range-proof payload has its own fixed-layout byte codec and never goes
+through pickle; aggregation/obfuscation/keyswitch/shuffle bodies use this.)
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+_ALLOWED_MODULE_ROOTS = ("numpy", "jax", "jaxlib", "drynx_tpu")
+# module -> names (exact) for the few stdlib pieces object pickling needs
+_ALLOWED_EXACT = {
+    "builtins": {"complex", "frozenset", "list", "set", "tuple", "dict",
+                 "bytearray"},
+    "copyreg": {"_reconstructor"},
+    "collections": {"OrderedDict"},
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        root = module.split(".")[0]
+        if root in _ALLOWED_MODULE_ROOTS:
+            return super().find_class(module, name)
+        if name in _ALLOWED_EXACT.get(module, ()):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"proof payload references forbidden global {module}.{name}")
+
+
+def safe_loads(data: bytes):
+    """pickle.loads restricted to proof-shaped content; raises
+    pickle.UnpicklingError on anything else."""
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+__all__ = ["safe_loads"]

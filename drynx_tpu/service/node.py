@@ -2,17 +2,27 @@
 
 One process = one node; the role (CN / DP / VN) is decided by roster
 position, exactly like the reference's single binary (cmd/README.md:13-18).
-The message flow mirrors SURVEY.md §3.1:
+The message flow mirrors SURVEY.md §3.1 — with proofs on, the FULL proof
+pipeline runs from each node's own process (reference
+services/service_data_provider.go:48 generateRangePI fires range proofs from
+the DP; services/service.go:533-558 hooks aggregation/obfuscation/keyswitch
+proofs at the CNs):
 
+  client ──vn_register──▶ each VN        (expected counts + verify context)
   client ──survey_query──▶ root CN
-     root CN ──survey_dp──▶ each DP     (encode + encrypt locally)
-     root CN aggregates ciphertexts     (device kernels)
-     root CN ──ks_contrib──▶ each CN    (partial decrypt + re-encrypt)
+     root CN ──range_sig──▶ each CN      (BB digit-signature setup per base u)
+     root CN ──survey_dp──▶ each DP      (encode + encrypt locally;
+                                          DP ──proof_request──▶ VNs  [range])
+     root CN aggregates ciphertexts      (root ──proof──▶ VNs  [aggregation])
+     root CN ──obf_contrib──▶ each CN    (obf ops: scalar-mult chain;
+                                          CN ──proof──▶ VNs  [obfuscation])
+     root CN ──shuffle_contrib──▶ each CN (diffP: DRO noise shuffle;
+                                          CN ──proof──▶ VNs  [shuffle])
+     root CN ──ks_contrib──▶ each CN     (partial decrypt + re-encrypt;
+                                          CN ──proof──▶ VNs  [keyswitch])
      root CN ◀─ contributions, assembles switched ciphertext
   client ◀── switched ciphertext, decrypts with its own key
-
-Proof envelopes go prover ──proof_request──▶ every VN;
-the root VN aggregates bitmaps (vn_bitmap) and commits the audit block.
+  client ──end_verification──▶ root VN   (counter-gated bitmap merge + block)
 """
 from __future__ import annotations
 
@@ -20,6 +30,7 @@ import dataclasses
 import pickle
 import secrets
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -27,14 +38,60 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import batching as B
+from ..crypto import curve as C
 from ..crypto import elgamal as eg
 from ..crypto import refimpl
 from ..encoding import stats as st
+from ..parallel import dro
+from ..proofs import aggregation as agg_proof
+from ..proofs import keyswitch as ks_proof
+from ..proofs import obfuscation as obf_proof
+from ..proofs import range_proof as rproof
 from ..proofs import requests as rq
 from ..proofs import schnorr
+from ..proofs import shuffle as shuffle_proof
+from ..proofs.safe_pickle import safe_loads
+from ..utils import log
 from .proof_collection import VerifyingNode
 from .skipchain import DataBlock
 from .transport import Conn, NodeServer, pack_array, unpack_array
+
+
+def _pack_bytes(b: bytes) -> dict:
+    return pack_array(np.frombuffer(b, dtype=np.uint8))
+
+
+def _unpack_bytes(d: dict) -> bytes:
+    return unpack_array(d).tobytes()
+
+
+def call_entry(entry, msg: dict, retries: int = 2,
+               timeout: float = 900.0) -> dict:
+    """One request/response to a roster entry with CONNECT retry + timeout
+    (the reference leans on onet's connect retry; errors here raise instead
+    of log.Fatal-ing the process).
+
+    Only connection ESTABLISHMENT is retried — once the request has been
+    sent, a timeout/reset must not re-execute it (survey_query and the
+    contribution handlers are not idempotent)."""
+    last: Optional[Exception] = None
+    conn = None
+    for attempt in range(retries + 1):
+        try:
+            conn = Conn(entry.host, entry.port, timeout=timeout)
+            break
+        except (ConnectionError, OSError) as e:
+            last = e
+            if attempt < retries:
+                time.sleep(0.2 * (attempt + 1))
+    if conn is None:
+        raise ConnectionError(
+            f"node {entry.name} at {entry.host}:{entry.port} unreachable "
+            f"after {retries + 1} attempts: {last!r}")
+    try:
+        return conn.call(msg)
+    finally:
+        conn.close()
 
 
 @dataclasses.dataclass
@@ -83,11 +140,17 @@ class DrynxNode:
         self.roster: Optional[Roster] = None
         self.vn: Optional[VerifyingNode] = None
         self._db_path = db_path or f"/tmp/drynx_node_{name}.db"
+        self._range_sigs: dict[int, rproof.RangeSig] = {}  # CN role, per u
+        self._survey_ctx: dict[str, dict] = {}             # VN role
+        self._proof_threads: dict[str, list] = {}          # prover roles
 
         s = self.server
         s.register("set_roster", self._h_set_roster)
         s.register("survey_query", self._h_survey_query)
         s.register("survey_dp", self._h_survey_dp)
+        s.register("range_sig", self._h_range_sig)
+        s.register("obf_contrib", self._h_obf_contrib)
+        s.register("shuffle_contrib", self._h_shuffle_contrib)
         s.register("ks_contrib", self._h_ks_contrib)
         s.register("proof_request", self._h_proof_request)
         s.register("vn_register", self._h_vn_register)
@@ -106,9 +169,6 @@ class DrynxNode:
     def stop(self):
         self.server.stop()
 
-    def _conn(self, entry: RosterEntry) -> Conn:
-        return Conn(entry.host, entry.port)
-
     # ------------------------------------------------------------------
     def _h_set_roster(self, msg: dict) -> dict:
         self.roster = Roster.from_dict(msg["roster"])
@@ -116,8 +176,92 @@ class DrynxNode:
         if me and me[0].role == "vn" and self.vn is None:
             pubs = {e.name: e.public for e in self.roster.entries}
             self.vn = VerifyingNode(self.name, self._db_path, pubs,
-                                    verify_fns={}, seed=0)
+                                    verify_fns=self._vn_verify_fns(), seed=0)
         return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # VN payload verifiers: real verification in the VN's own process
+    # (round-1 gap: distributed VNs had verify_fns={} so every payload was
+    # BM_RECVD at best; reference VNs verify, structs_proofs.go:135-492)
+    # ------------------------------------------------------------------
+    def _vn_verify_fns(self):
+        def ctx_of(sid: str) -> Optional[dict]:
+            return self._survey_ctx.get(sid)
+
+        def vrange(data: bytes, sid: str) -> bool:
+            ctx = ctx_of(sid)
+            if ctx is None:
+                return False
+            lst = rproof.RangeProofList.from_bytes(data)
+            return rproof.verify_range_proof_list(
+                lst, ctx["ranges_v"], ctx["sigs_pub_by_u"],
+                self._pub_table(ctx["coll_pub"]).table)
+
+        def vagg(data: bytes, _sid: str) -> bool:
+            return bool(np.all(agg_proof.verify_aggregation_proof(
+                safe_loads(data))))
+
+        def vobf(data: bytes, _sid: str) -> bool:
+            return bool(np.all(obf_proof.verify_obfuscation_proofs(
+                safe_loads(data))))
+
+        def vks(data: bytes, sid: str) -> bool:
+            ctx = ctx_of(sid)
+            if ctx is None:
+                return False
+            return bool(np.all(ks_proof.verify_keyswitch_proofs(
+                safe_loads(data),
+                self._pub_table(ctx["client_pub"]).table)))
+
+        def vshuffle(data: bytes, sid: str) -> bool:
+            ctx = ctx_of(sid)
+            if ctx is None:
+                return False
+            proof, in_cts, out_cts = safe_loads(data)
+            return shuffle_proof.verify_shuffle(
+                proof, jnp.asarray(in_cts), jnp.asarray(out_cts),
+                jnp.asarray(C.from_ref(ctx["coll_pub"])))
+
+        return {"range": vrange, "aggregation": vagg, "obfuscation": vobf,
+                "keyswitch": vks, "shuffle": vshuffle}
+
+    # ------------------------------------------------------------------
+    # Async proof delivery to every VN (the reference's goroutine pipeline,
+    # data_collection_protocol.go:279-347)
+    # ------------------------------------------------------------------
+    def _send_proof_async(self, ptype: str, survey_id: str, differ: str,
+                          data: bytes) -> threading.Thread:
+        req = rq.new_proof_request(ptype, survey_id, self.name, differ, 0,
+                                   data, self.secret)
+        vns = self.roster.of_role("vn")
+
+        def work():
+            frame = {"type": "proof_request", "proof_type": ptype,
+                     "survey_id": survey_id, "sender_id": self.name,
+                     "differ_info": differ, "round_id": 0,
+                     "data": _pack_bytes(req.data),
+                     "signature": _pack_bytes(req.signature.to_bytes())}
+            for e in vns:
+                try:
+                    call_entry(e, frame)
+                except Exception as err:
+                    # an unreachable/erroring VN simply never counts this
+                    # proof; the end_verification counter gate reports the
+                    # shortfall. Keep delivering to the REMAINING VNs.
+                    log.warn(f"{self.name}: {ptype} proof undeliverable to "
+                             f"VN {e.name}: {err}")
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        # prune finished surveys' threads so long-lived DP/CN processes don't
+        # accumulate Thread objects across surveys
+        for sid in list(self._proof_threads):
+            self._proof_threads[sid] = [
+                x for x in self._proof_threads[sid] if x.is_alive()]
+            if not self._proof_threads[sid] and sid != survey_id:
+                del self._proof_threads[sid]
+        self._proof_threads.setdefault(survey_id, []).append(t)
+        return t
 
     def _pub_table(self, pub: tuple) -> eg.FixedBase:
         """Fixed-base tables are key-lifetime objects: cache per affine point
@@ -129,7 +273,33 @@ class DrynxNode:
             cache[pub] = eg.pub_table(pub)
         return cache[pub]
 
-    # -- DP side: encode + encrypt local data (survey_dp)
+    # -- CN side: own BB digit-signature set for base u (reference
+    # InitRangeProofSignature, range_proof.go:270-288 — per-server secret)
+    def _h_range_sig(self, msg: dict) -> dict:
+        u = int(msg["u"])
+        if u not in self._range_sigs:
+            rng = np.random.default_rng(secrets.randbits(63))
+            self._range_sigs[u] = rproof.init_range_sig(u, rng)
+        sg = self._range_sigs[u]
+        return {"pub": [int(sg.public[0]), int(sg.public[1])],
+                "A": pack_array(sg.A)}
+
+    @staticmethod
+    def _sigs_from_msg(range_sigs_msg: dict) -> dict:
+        """{u: [RangeSig(pub-only)]} from the wire form sent by the root CN
+        (A tables stacked (ns, u, 3, 2, 16), publics per CN)."""
+        out = {}
+        for u_str, blob in range_sigs_msg.items():
+            A_all = unpack_array(blob["A"])
+            pubs = [tuple(int(t) for t in p) for p in blob["pubs"]]
+            out[int(u_str)] = [
+                rproof.RangeSig(secret=0, public=pubs[i], A=A_all[i])
+                for i in range(A_all.shape[0])]
+        return out
+
+    # -- DP side: encode + encrypt local data (survey_dp); with proofs on,
+    # fire the range-proof list at the VNs from THIS process (reference
+    # service_data_provider.go:48 generateRangePI)
     def _h_survey_dp(self, msg: dict) -> dict:
         op = msg["op"]
         qmin, qmax = msg["query_min"], msg["query_max"]
@@ -142,10 +312,60 @@ class DrynxNode:
         # fresh OS entropy: blinding scalars must never be derivable from
         # survey metadata, and must differ across runs of the same survey
         key = jax.random.PRNGKey(secrets.randbits(63))
-        cts, _ = eg.encrypt_ints(key, tbl, jnp.asarray(stats))
+        cts, rs = eg.encrypt_ints(key, tbl, jnp.asarray(stats))
+
+        if msg.get("proofs"):
+            ranges_v = [tuple(r) for r in msg["ranges"]]
+            sigs_by_u = self._sigs_from_msg(msg["range_sigs"])
+            key2 = jax.random.PRNGKey(secrets.randbits(63))
+            lst = rproof.create_range_proof_list(
+                key2, stats, rs, cts, ranges_v, sigs_by_u, tbl.table)
+            self._send_proof_async("range", msg["survey_id"],
+                                   f"range-{self.name}", lst.to_bytes())
         return {"cts": pack_array(np.asarray(cts))}
 
-    # -- CN side: key-switch contribution for an aggregate
+    # -- CN side: obfuscation contribution — multiply every ciphertext by a
+    # fresh secret scalar (reference obfuscation_protocol.go:241-243) and
+    # prove it (lib/obfuscation/obfuscation_proof.go:47)
+    def _h_obf_contrib(self, msg: dict) -> dict:
+        cts = jnp.asarray(unpack_array(msg["cts"]))
+        V = cts.shape[0]
+        key = jax.random.PRNGKey(secrets.randbits(63))
+        k_s, k_w = jax.random.split(key)
+        s = eg.random_scalars(k_s, (V,))
+        if msg.get("proofs"):
+            pr = obf_proof.create_obfuscation_proofs(k_w, cts, s)
+            self._send_proof_async("obfuscation", msg["survey_id"],
+                                   f"obf-{self.name}", pickle.dumps(pr))
+            out = pr.obf
+        else:
+            out = B.ct_scalar_mul(cts, s)
+        return {"cts": pack_array(np.asarray(out))}
+
+    # -- CN side: DRO shuffle contribution (reference unlynx shuffling
+    # protocol with proof, SURVEY.md §2.2; Neff-style argument)
+    def _h_shuffle_contrib(self, msg: dict) -> dict:
+        cts = jnp.asarray(unpack_array(msg["cts"]))
+        coll_pub = self.roster.collective_pub()
+        tbl = self._pub_table(coll_pub)
+        key = jax.random.PRNGKey(secrets.randbits(63))
+        out_cts, perm, rs = dro.shuffle_rerandomize(key, cts, tbl.table)
+        if msg.get("proofs"):
+            from ..crypto.params import from_limbs
+
+            betas = [from_limbs(r) for r in np.asarray(rs)]
+            pr = shuffle_proof.prove_shuffle(
+                cts, out_cts, np.asarray(perm), betas,
+                jnp.asarray(C.from_ref(coll_pub)),
+                np.random.default_rng(secrets.randbits(128)))
+            self._send_proof_async(
+                "shuffle", msg["survey_id"], f"shuffle-{self.name}",
+                pickle.dumps((pr, np.asarray(cts), np.asarray(out_cts))))
+        return {"cts": pack_array(np.asarray(out_cts))}
+
+    # -- CN side: key-switch contribution for an aggregate; with proofs on,
+    # a per-CN keyswitch proof (ns=1 batch) goes to the VNs (reference
+    # service.go:566-616 proof hook)
     def _h_ks_contrib(self, msg: dict) -> dict:
         K0 = jnp.asarray(unpack_array(msg["k_component"]))   # (V, 3, 16)
         client_pub = tuple(msg["client_pub"])
@@ -158,61 +378,137 @@ class DrynxNode:
         rQ = B.fixed_base_mul(q_tbl.table, rs)
         xK = B.g1_scalar_mul(K0, x)
         w_pts = B.g1_add(rQ, B.g1_neg(xK))
+        if msg.get("proofs"):
+            key2 = jax.random.PRNGKey(secrets.randbits(63))
+            pr = ks_proof.create_keyswitch_proofs(
+                key2, K0, x[None], rs[None],
+                jnp.asarray(C.from_ref(client_pub)), q_tbl.table,
+                jnp.asarray(u_pts)[None], jnp.asarray(w_pts)[None])
+            self._send_proof_async("keyswitch", msg["survey_id"],
+                                   f"ks-{self.name}", pickle.dumps(pr))
         return {"u": pack_array(np.asarray(u_pts)),
                 "w": pack_array(np.asarray(w_pts))}
 
-    # -- root CN: the whole survey
+    def _call_cn(self, entry, msg: dict) -> dict:
+        """Dispatch to a CN — loopback for self, TCP otherwise."""
+        if entry.name == self.name:
+            return self.server.handlers[msg["type"]](msg)
+        return call_entry(entry, msg)
+
+    # -- root CN: the whole survey (reference HandleSurveyQuery +
+    # StartService phase order, service.go:263-747)
     def _h_survey_query(self, msg: dict) -> dict:
-        assert self.roster is not None, "roster not set"
+        if self.roster is None:
+            raise RuntimeError("roster not set (send set_roster first)")
         op = msg["op"]
         survey_id = msg["survey_id"]
+        proofs = bool(msg.get("proofs"))
+        ranges_v = [tuple(r) for r in msg.get("ranges") or []]
         dps = self.roster.of_role("dp")
         cns = self.roster.of_role("cn")
+        log.lvl1(f"{self.name}: survey {survey_id} op={op} "
+                 f"dps={len(dps)} cns={len(cns)} proofs={int(proofs)}")
 
-        # collect encrypted DP responses (star topology)
+        # range-signature setup: every CN publishes its BB digit signatures
+        # for each distinct base u in the query's ranges
+        range_sigs_msg: dict = {}
+        if proofs and ranges_v:
+            for (u, _l) in rproof.group_ranges(ranges_v):
+                pubs, As = [], []
+                for e in cns:
+                    r = self._call_cn(e, {"type": "range_sig", "u": u})
+                    pubs.append([int(t) for t in r["pub"]])
+                    As.append(unpack_array(r["A"]))
+                range_sigs_msg[str(u)] = {"pubs": pubs,
+                                          "A": pack_array(np.stack(As))}
+
+        # collect encrypted DP responses (star topology); DPs fire range
+        # proofs at the VNs from their own processes
         cts = []
         for e in dps:
-            with_conn = self._conn(e)
-            try:
-                r = with_conn.call({"type": "survey_dp", "op": op,
-                                    "survey_id": survey_id,
-                                    "query_min": msg["query_min"],
-                                    "query_max": msg["query_max"]})
-            finally:
-                with_conn.close()
+            r = call_entry(e, {"type": "survey_dp", "op": op,
+                               "survey_id": survey_id,
+                               "query_min": msg["query_min"],
+                               "query_max": msg["query_max"],
+                               "proofs": proofs, "ranges": ranges_v,
+                               "range_sigs": range_sigs_msg})
             cts.append(unpack_array(r["cts"]))
         cts = jnp.asarray(np.stack(cts))                     # (n_dps, V, 2,3,16)
         agg = B.tree_reduce_add(cts, B.ct_add)
+        if proofs:
+            self._send_proof_async(
+                "aggregation", survey_id, f"agg-{self.name}",
+                pickle.dumps(agg_proof.create_aggregation_proof(cts, agg)))
+
+        # obfuscation chain over the CNs (zero/nonzero-semantics ops)
+        if msg.get("obfuscation"):
+            for e in cns:
+                r = self._call_cn(e, {"type": "obf_contrib",
+                                      "survey_id": survey_id,
+                                      "proofs": proofs,
+                                      "cts": pack_array(np.asarray(agg))})
+                agg = jnp.asarray(unpack_array(r["cts"]))
+
+        # DRO / differential-privacy noise: root builds the encrypted noise
+        # list, every CN shuffles + re-randomizes it in turn, one noise ct
+        # lands on each result (reference service.go:600-665,809-851)
+        diffp = msg.get("diffp") or {}
+        if diffp.get("noise_list_size", 0) > 0:
+            noise = dro.generate_noise_values(
+                int(diffp["noise_list_size"]), float(diffp["lap_mean"]),
+                float(diffp["lap_scale"]), float(diffp["quanta"]),
+                float(diffp["scale"]), float(diffp["limit"]))
+            tbl = self._pub_table(self.roster.collective_pub())
+            n_cts = dro.encrypt_noise(
+                jax.random.PRNGKey(secrets.randbits(63)), tbl, noise)
+            for e in cns:
+                r = self._call_cn(e, {"type": "shuffle_contrib",
+                                      "survey_id": survey_id,
+                                      "proofs": proofs,
+                                      "cts": pack_array(np.asarray(n_cts))})
+                n_cts = jnp.asarray(unpack_array(r["cts"]))
+            V = int(agg.shape[0])
+            idx = np.arange(V) % int(n_cts.shape[0])
+            agg = B.ct_add(agg, jnp.take(n_cts, jnp.asarray(idx), axis=0))
 
         # key switch: gather contributions from every CN (including self)
         K0 = np.asarray(agg[:, 0])
         k_sum = c_sum = None
         for e in cns:
-            if e.name == self.name:
-                r = self._h_ks_contrib({"k_component": pack_array(K0),
-                                        "client_pub": list(msg["client_pub"]),
-                                        "survey_id": survey_id})
-            else:
-                conn = self._conn(e)
-                try:
-                    r = conn.call({"type": "ks_contrib",
-                                   "k_component": pack_array(K0),
-                                   "client_pub": list(msg["client_pub"]),
-                                   "survey_id": survey_id})
-                finally:
-                    conn.close()
+            r = self._call_cn(e, {"type": "ks_contrib",
+                                  "k_component": pack_array(K0),
+                                  "client_pub": list(msg["client_pub"]),
+                                  "survey_id": survey_id, "proofs": proofs})
             u = jnp.asarray(unpack_array(r["u"]))
             w = jnp.asarray(unpack_array(r["w"]))
             k_sum = u if k_sum is None else B.g1_add(k_sum, u)
             c_sum = w if c_sum is None else B.g1_add(c_sum, w)
 
         switched = jnp.stack([k_sum, B.g1_add(agg[:, 1], c_sum)], axis=-3)
+        # let this node's own proof threads drain before replying so the
+        # querier's end_verification doesn't race local stragglers
+        for t in self._proof_threads.pop(survey_id, []):
+            t.join(timeout=300)
         return {"switched": pack_array(np.asarray(switched))}
 
     # -- VN handlers
     def _h_vn_register(self, msg: dict) -> dict:
-        self.vn.register_survey(msg["survey_id"], msg["expected"],
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN (no roster, or "
+                               "not in the vn role)")
+        sid = msg["survey_id"]
+        self.vn.register_survey(sid, msg["expected"],
                                 msg.get("thresholds", {}))
+        if msg.get("proofs"):
+            sigs_pub_by_u = {
+                int(u): [tuple(int(t) for t in p) for p in pubs]
+                for u, pubs in (msg.get("range_sig_pubs") or {}).items()}
+            self._survey_ctx[sid] = {
+                "coll_pub": self.roster.collective_pub(),
+                "client_pub": tuple(int(t) for t in msg["client_pub"]),
+                "ranges_v": [tuple(r) for r in msg.get("ranges") or []],
+                "sigs_pub_by_u": sigs_pub_by_u,
+            }
         return {"ok": True}
 
     def _h_proof_request(self, msg: dict) -> dict:
@@ -226,29 +522,60 @@ class DrynxNode:
         return {"code": code}
 
     def _h_vn_bitmap(self, msg: dict) -> dict:
-        return {"bitmap": self.vn.bitmap_for(msg["survey_id"])}
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN")
+        sid = msg["survey_id"]
+        state = self.vn.surveys.get(sid)
+        if state is None:
+            raise RuntimeError(f"unknown survey {sid!r} at VN {self.name}")
+        if msg.get("wait"):
+            # block until this VN's expected-proof counter drains
+            if not state.done.wait(float(msg.get("timeout", 300.0))):
+                raise TimeoutError(
+                    f"VN {self.name}: {len(state.bitmap)}/{state.expected} "
+                    f"proofs received for {sid!r}")
+        return {"bitmap": self.vn.bitmap_for(sid),
+                "expected": state.expected}
 
     def _h_end_verification(self, msg: dict) -> dict:
+        """Root VN: counter-gated bitmap merge + audit-block commit.
+
+        Round-1 weakness fixed: a survey with missing proofs can no longer
+        commit a clean-looking block — every VN must have received its full
+        expected count (reference: the bitmap-aggregation goroutine only
+        fires after the proof counter reaches zero,
+        proof_collection_protocol.go:362-398)."""
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN")
         survey_id = msg["survey_id"]
+        timeout = float(msg.get("timeout", 300.0))
         vns = self.roster.of_role("vn")
+        state = self.vn.surveys.get(survey_id)
+        if state is None:
+            raise RuntimeError(f"unknown survey {survey_id!r}")
+        if not state.done.wait(timeout):
+            raise TimeoutError(
+                f"root VN {self.name}: {len(state.bitmap)}/{state.expected} "
+                f"proofs received for {survey_id!r}")
         merged = {}
         for e in vns:
             if e.name == self.name:
-                bm = self.vn.bitmap_for(survey_id)
+                bm, expected = self.vn.bitmap_for(survey_id), state.expected
             else:
-                conn = self._conn(e)
-                try:
-                    bm = conn.call({"type": "vn_bitmap",
-                                    "survey_id": survey_id})["bitmap"]
-                finally:
-                    conn.close()
+                r = call_entry(e, {"type": "vn_bitmap",
+                                   "survey_id": survey_id,
+                                   "wait": True, "timeout": timeout})
+                bm, expected = r["bitmap"], r["expected"]
+            if len(bm) < expected:
+                raise RuntimeError(
+                    f"VN {e.name} reports {len(bm)}/{expected} proofs for "
+                    f"{survey_id!r}; refusing to commit an audit block")
             for k, v in bm.items():
                 merged[f"{e.name}:{k}"] = v
-        import time as _time
 
         self.vn.local_bitmaps[survey_id] = merged
         block = self.vn.chain.append(
-            DataBlock(survey_id=survey_id, sample_time=_time.time(),
+            DataBlock(survey_id=survey_id, sample_time=time.time(),
                       bitmap=merged))
         return {"block_index": block.index, "block_hash": block.hash(),
                 "bitmap": merged}
@@ -270,18 +597,74 @@ class RemoteClient:
             finally:
                 c.close()
 
+    def expected_proofs(self, n_dps: int, n_cns: int, obfuscation: bool,
+                        diffp: bool) -> int:
+        """Proof count every VN must receive for one survey over the TCP
+        path: range per DP, ONE aggregation (the root aggregates the whole
+        star — unlike the in-process tree there is exactly one aggregator),
+        keyswitch per CN, obfuscation/shuffle per CN when enabled."""
+        return (n_dps + 1 + n_cns + (n_cns if obfuscation else 0)
+                + (n_cns if diffp else 0))
+
+    @staticmethod
+    def _diffp_on(diffp: Optional[dict]) -> bool:
+        """Mirror the root CN's gate exactly: the shuffle chain (and its
+        proofs) only run when noise_list_size > 0."""
+        return bool(diffp and int(diffp.get("noise_list_size", 0)) > 0)
+
     def run_survey(self, op: str, query_min: int = 0, query_max: int = 0,
                    survey_id: str = "sv-remote",
-                   dlog: Optional[eg.DecryptionTable] = None):
-        root = self.roster.of_role("cn")[0]
-        conn = Conn(root.host, root.port)
-        try:
-            r = conn.call({"type": "survey_query", "op": op,
-                           "survey_id": survey_id,
-                           "query_min": query_min, "query_max": query_max,
-                           "client_pub": list(self.public)})
-        finally:
-            conn.close()
+                   dlog: Optional[eg.DecryptionTable] = None,
+                   proofs: bool = False, ranges=None,
+                   obfuscation: bool = False, diffp: Optional[dict] = None,
+                   thresholds: float = 1.0, timeout: float = 300.0):
+        """Full remote survey. With proofs on: collect range-sig publics from
+        the CNs, register the survey (+ verify context) at every VN, run the
+        query, then block on the root VN's counter-gated audit block
+        (reference SendSurveyQueryToVNs + SendEndVerification,
+        services/api_skipchain.go:16-46). Returns (result, block_info)."""
+        cns = self.roster.of_role("cn")
+        dps = self.roster.of_role("dp")
+        vns = self.roster.of_role("vn")
+        root = cns[0]
+
+        if proofs:
+            if ranges is None:
+                from ..encoding import output_size
+
+                ranges = [(16, 4)] * output_size(op, query_min, query_max)
+            if not vns:
+                raise ValueError("proofs on but the roster has no VNs")
+            from ..proofs.range_proof import group_ranges
+
+            sig_pubs = {}
+            for (u, _l) in group_ranges(ranges):
+                pubs = []
+                for e in cns:
+                    r = call_entry(e, {"type": "range_sig", "u": u})
+                    pubs.append([int(t) for t in r["pub"]])
+                sig_pubs[str(u)] = pubs
+            expected = self.expected_proofs(
+                len(dps), len(cns), obfuscation, self._diffp_on(diffp))
+            for e in vns:
+                call_entry(e, {
+                    "type": "vn_register", "survey_id": survey_id,
+                    "expected": expected, "proofs": True,
+                    "thresholds": {t: thresholds for t in rq.PROOF_TYPES},
+                    "client_pub": list(self.public),
+                    "ranges": [list(r) for r in ranges],
+                    "range_sig_pubs": sig_pubs})
+
+        r = call_entry(root, {"type": "survey_query", "op": op,
+                              "survey_id": survey_id,
+                              "query_min": query_min,
+                              "query_max": query_max,
+                              "proofs": proofs,
+                              "ranges": [list(t) for t in ranges or []],
+                              "obfuscation": obfuscation,
+                              "diffp": diffp,
+                              "client_pub": list(self.public)},
+                       timeout=max(timeout, 900.0))
         switched = jnp.asarray(unpack_array(r["switched"]))
         dl = dlog or eg.DecryptionTable(limit=10000)
         xq = jnp.asarray(eg.secret_to_limbs(self.secret))
@@ -291,7 +674,15 @@ class RemoteClient:
         dec = st.DecryptedVector(values=np.asarray(vals),
                                  found=np.asarray(found),
                                  is_zero=np.asarray(zeros))
-        return st.decode(op, dec, query_min, query_max)
+        result = st.decode(op, dec, query_min, query_max)
+        if not proofs:
+            return result
+
+        block = call_entry(vns[0], {"type": "end_verification",
+                                    "survey_id": survey_id,
+                                    "timeout": timeout},
+                           timeout=timeout + 60.0)
+        return result, block
 
 
 __all__ = ["RosterEntry", "Roster", "DrynxNode", "RemoteClient"]

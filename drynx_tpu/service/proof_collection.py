@@ -24,6 +24,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..proofs import requests as rq
+from ..utils import log
 from .skipchain import DataBlock, SkipChain, bitmap_verifier
 from .store import ProofDB
 
@@ -75,6 +76,10 @@ class VerifyingNode:
             st.bitmap[key] = code
             self.db.put(key, req.data)
             remaining = st.expected - len(st.bitmap)
+        if code not in (rq.BM_TRUE, rq.BM_RECVD):
+            log.warn(f"VN {self.name}: proof {key} -> code {code}")
+        log.lvl3(f"VN {self.name}: {key} code={code}, "
+                 f"{remaining} proofs outstanding")
         if remaining <= 0:
             st.done.set()
         return code

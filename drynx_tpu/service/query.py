@@ -60,6 +60,18 @@ class Query:
     dp_data_min: int = 0                # dummy-data generation bounds
     dp_data_max: int = 0
     sigs_present: bool = False          # input-validation signatures set
+    # Group-by: candidate values per group attribute (reference
+    # AllPossibleGroups, protocols/data_collection_protocol.go:186-196);
+    # e.g. [[0, 1], [10, 20, 30]] = 2 attributes, 6 groups. None = ungrouped.
+    group_by: Optional[list] = None
+
+    def n_groups(self) -> int:
+        if not self.group_by:
+            return 1
+        n = 1
+        for vals in self.group_by:
+            n *= len(vals)
+        return n
 
 
 @dataclasses.dataclass

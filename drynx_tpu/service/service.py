@@ -41,6 +41,7 @@ from ..proofs import obfuscation as obf_proof
 from ..proofs import range_proof as rproof
 from ..proofs import requests as rq
 from ..proofs import shuffle as shuffle_proof
+from ..utils import log
 from ..utils.timers import PhaseTimers
 from .proof_collection import VerifyingNode, VNGroup
 from .query import (DiffPParams, Operation, Query, SurveyQuery,
@@ -63,11 +64,15 @@ class DataProvider:
     """DP role: local data -> sufficient statistics -> ciphertexts + proofs
     (reference GenerateData, data_collection_protocol.go:178-374)."""
 
-    def __init__(self, ident: NodeIdentity, data=None):
+    def __init__(self, ident: NodeIdentity, data=None, groups=None):
         self.ident = ident
         self.data = data  # op-dependent host array (or (X, y) for log_reg)
+        self.groups = groups  # int64 (rows, n_attrs) group labels, or None
 
-    def local_stats(self, op: Operation, rng) -> np.ndarray:
+    def local_stats(self, op: Operation, rng, group_by=None) -> np.ndarray:
+        """(V,) ungrouped, or (n_groups, V) when the query groups
+        (reference GenerateData encodes per group,
+        data_collection_protocol.go:254-267)."""
         if op.name == "log_reg":
             X, y = self.data
             return np.asarray(lr.encode_clear(X, y, op.lr_params))
@@ -75,6 +80,15 @@ class DataProvider:
         if data is None:  # dummy data like createFakeDataForOperation
             data = rng.integers(op.query_min, max(op.query_max, 1),
                                 size=(32,)).astype(np.int64)
+        if group_by:
+            groups = self.groups
+            if groups is None:  # dummy group labels (fake-data path)
+                groups = np.stack(
+                    [rng.choice(np.asarray(vals), size=len(data))
+                     for vals in group_by], axis=-1).astype(np.int64)
+            grid = st.group_grid(group_by)
+            return np.asarray(st.encode_clear_grouped(
+                op.name, data, groups, grid, op.query_min, op.query_max))
         return np.asarray(st.encode_clear(
             op.name, data, op.query_min, op.query_max))
 
@@ -140,37 +154,41 @@ class LocalCluster:
     # Proof payload verifiers installed at the VNs
     # ------------------------------------------------------------------
     def _verify_fns(self):
-        def vrange(data: bytes) -> bool:
-            pb = rproof.RangeProofBatch.from_bytes(data)
-            sigs = self.range_sigs.get(pb.u)
-            if sigs is None:
+        def vrange(data: bytes, survey_id: str) -> bool:
+            lst = rproof.RangeProofList.from_bytes(data)
+            survey = self.surveys.get(survey_id)
+            if survey is None:
                 return False
-            return bool(np.all(rproof.verify_range_proofs(
-                pb, [s.public for s in sigs], self.coll_tbl.table)))
+            expected = self._ranges_per_value(survey.sq.query)
+            sigs_pub_by_u = {
+                u: [s.public for s in sigs]
+                for u, sigs in self.range_sigs.items()}
+            return rproof.verify_range_proof_list(
+                lst, expected, sigs_pub_by_u, self.coll_tbl.table)
 
-        def vagg(data: bytes) -> bool:
-            import pickle
+        def vagg(data: bytes, _sid: str) -> bool:
+            from ..proofs.safe_pickle import safe_loads
 
-            proof = pickle.loads(data)
+            proof = safe_loads(data)
             return bool(np.all(agg_proof.verify_aggregation_proof(proof)))
 
-        def vobf(data: bytes) -> bool:
-            import pickle
+        def vobf(data: bytes, _sid: str) -> bool:
+            from ..proofs.safe_pickle import safe_loads
 
-            proof = pickle.loads(data)
+            proof = safe_loads(data)
             return bool(np.all(obf_proof.verify_obfuscation_proofs(proof)))
 
-        def vks(data: bytes) -> bool:
-            import pickle
+        def vks(data: bytes, _sid: str) -> bool:
+            from ..proofs.safe_pickle import safe_loads
 
-            proof = pickle.loads(data)
+            proof = safe_loads(data)
             return bool(np.all(ks_proof.verify_keyswitch_proofs(
                 proof, self.client_tbl.table)))
 
-        def vshuffle(data: bytes) -> bool:
-            import pickle
+        def vshuffle(data: bytes, _sid: str) -> bool:
+            from ..proofs.safe_pickle import safe_loads
 
-            proof, in_cts, out_cts = pickle.loads(data)
+            proof, in_cts, out_cts = safe_loads(data)
             return shuffle_proof.verify_shuffle(
                 proof, jnp.asarray(in_cts), jnp.asarray(out_cts),
                 jnp.asarray(C.from_ref(self.coll_pub)))
@@ -186,9 +204,18 @@ class LocalCluster:
                               proofs: int = 0, obfuscation: bool = False,
                               ranges=None, diffp: Optional[DiffPParams] = None,
                               lr_params=None, thresholds: float = 1.0,
-                              cutting_factor: int = 0) -> SurveyQuery:
+                              cutting_factor: int = 0,
+                              group_by=None) -> SurveyQuery:
         op = choose_operation(op_name, query_min, query_max, dims,
                               cutting_factor, lr_params)
+        if group_by and op_name == "log_reg":
+            raise ValueError("group_by is not supported for log_reg")
+        if (op_name == "log_reg" and proofs and ranges
+                and len(set(map(tuple, ranges))) > 1):
+            # the signed-encoding shift (run_survey) derives ONE offset from
+            # the spec; per-index specs would shift values out of range
+            raise ValueError(
+                "log_reg range proofs require a uniform (u, l) spec")
         if proofs and ranges is None:
             # default range: values fit in [0, 16^4)
             ranges = [(16, 4)] * op.nbr_output
@@ -197,7 +224,8 @@ class LocalCluster:
                   diffp=diffp or DiffPParams(),
                   dp_data_min=query_min, dp_data_max=query_max,
                   sigs_present=proofs == 1 and ranges is not None
-                  and not all(u == 0 and l == 0 for (u, l) in ranges))
+                  and not all(u == 0 and l == 0 for (u, l) in ranges),
+                  group_by=group_by)
         sq = SurveyQuery(
             survey_id=f"survey-{secrets.token_hex(4)}",
             query=q,
@@ -228,6 +256,30 @@ class LocalCluster:
                                   for _ in self.cns]
         return self.range_sigs[u]
 
+    def prewarm_dro(self, noise_size: int, n_surveys: int = 1,
+                    seed: int = 0) -> None:
+        """Pre-fill the shuffle-precomputation pool: one fresh entry per
+        (CN, survey). The reference does this at survey setup and persists
+        it (service.go:316-317 PrecomputationWritingForShuffling) so the
+        timed DRO phase only permutes + adds."""
+        pool = getattr(self, "_shuffle_precomp", None)
+        if pool is None:
+            pool = self._shuffle_precomp = {}
+        key = jax.random.PRNGKey(secrets.randbits(63) ^ seed)
+        for ci in range(len(self.cns)):
+            for _ in range(n_surveys):
+                key, k_pc = jax.random.split(key)
+                pool.setdefault((ci, noise_size), []).append(
+                    dro.precompute_rerandomization(
+                        k_pc, self.coll_tbl.table, noise_size))
+
+    @staticmethod
+    def _ranges_per_value(q) -> list:
+        """Per-OUTPUT-INDEX (u, l) specs: the query's per-V ranges, tiled
+        across group-by groups (every group's value i shares spec i —
+        reference validates per-index ranges, lib/structs.go:446-533)."""
+        return list(q.ranges) * (q.n_groups() if q.group_by else 1)
+
     # ------------------------------------------------------------------
     # The full survey (reference SendSurveyQuery path, SURVEY.md §3.1)
     # ------------------------------------------------------------------
@@ -239,6 +291,9 @@ class LocalCluster:
         tm = survey.timers
         key = jax.random.PRNGKey(seed)
         proofs_on = q.proofs == 1 and self.vns is not None
+        log.lvl1(f"survey {sq.survey_id}: op={op.name} "
+                 f"dps={len(self.dp_idents)} cns={len(self.cns)} "
+                 f"proofs={int(proofs_on)} groups={q.n_groups()}")
 
         if proofs_on:
             nbrs = query_to_proofs_nbrs(sq)
@@ -254,9 +309,30 @@ class LocalCluster:
         # --- DP phase: encode + encrypt (+ range proofs) ----------------
         tm.start("DataCollectionProtocol")
         dp_stats = np.stack([
-            self.dps[d.name].local_stats(op, self.rng)
-            for d in self.dp_idents])                       # (n_dps, V)
+            self.dps[d.name].local_stats(op, self.rng, q.group_by)
+            for d in self.dp_idents])              # (n_dps, V) or (n_dps,G,Vg)
+        if q.group_by:
+            # group-major flatten: the aligned group axis makes element-wise
+            # homomorphic addition the per-group aggregation (no same-group
+            # matching; reference data_collection_protocol.go:157-168)
+            dp_stats = dp_stats.reshape(dp_stats.shape[0], -1)
         V = dp_stats.shape[1]
+
+        # Sound range proofs for signed encodings: logreg fixed-point
+        # coefficients can be negative, which a [0, u^l) digit proof cannot
+        # express (the reference's ToBase silently emits NO digits for
+        # negative secrets, range_proof.go:584 — its LR range proofs are
+        # vacuous). We instead SHIFT each plaintext by u^l/2 so the proved
+        # statement is real, and homomorphically subtract the public
+        # n_dps*offset from the key-switched result before decryption.
+        range_offset = 0
+        if proofs_on and op.name == "log_reg" and q.ranges:
+            u0, l0 = q.ranges[0]
+            if u0:
+                range_offset = (int(u0) ** int(l0)) // 2
+                assert int(np.abs(dp_stats).max()) < range_offset, \
+                    "logreg encoding exceeds range proof bound u^l/2"
+                dp_stats = dp_stats + range_offset
         key, k_enc = jax.random.split(key)
         enc_rs = eg.random_scalars(k_enc, dp_stats.shape)
         m = B.int_to_scalar(jnp.asarray(dp_stats))
@@ -265,16 +341,18 @@ class LocalCluster:
         tm.end("DataCollectionProtocol")
 
         if proofs_on:
-            u, l = q.ranges[0]
-            sigs = self.ensure_range_sigs(u)
+            ranges_v = self._ranges_per_value(q)
+            sigs_by_u = {u: self.ensure_range_sigs(u)
+                         for (u, _l) in rproof.group_ranges(ranges_v)}
             for i, dp in enumerate(self.dp_idents):
                 key, k_rp = jax.random.split(key)
                 self._async_proof(
                     survey, "range", dp,
-                    lambda i=i, k_rp=k_rp, u=u, l=l, sigs=sigs:
-                    rproof.create_range_proofs(
-                        k_rp, dp_stats[i], enc_rs[i], cts[i], sigs, u, l,
-                        self.coll_tbl.table).to_bytes())
+                    lambda i=i, k_rp=k_rp, ranges_v=ranges_v,
+                    sigs_by_u=sigs_by_u:
+                    rproof.create_range_proof_list(
+                        k_rp, dp_stats[i], enc_rs[i], cts[i], ranges_v,
+                        sigs_by_u, self.coll_tbl.table).to_bytes())
 
         # --- Aggregation phase (reference AggregationPhase :775) --------
         tm.start("AggregationPhase")
@@ -282,11 +360,12 @@ class LocalCluster:
         agg.block_until_ready()
         tm.end("AggregationPhase")
         if proofs_on:
+            # each CN signs its own request but the (transparent) proof body
+            # is identical — build + serialize it ONCE, not per CN
+            agg_bytes = _once(lambda: _pickle(
+                agg_proof.create_aggregation_proof(cts, agg)))
             for cn in self.cns:
-                self._async_proof(
-                    survey, "aggregation", cn,
-                    lambda: _pickle(agg_proof.create_aggregation_proof(
-                        cts, agg)))
+                self._async_proof(survey, "aggregation", cn, agg_bytes)
 
         # --- Obfuscation phase (zero/nonzero ops only) ------------------
         if q.obfuscation:
@@ -320,10 +399,26 @@ class LocalCluster:
                 d.scale, d.limit)
             key, k_n = jax.random.split(key)
             n_cts = dro.encrypt_noise(k_n, self.coll_tbl, noise)
-            for cn in self.cns:
+            # per-(CN, size) precomputation POOL (reference gob cache,
+            # service.go:34,316-317) — the fixed-base mults are the hot
+            # cost. Entries are CONSUMED (popped), never reused: re-using a
+            # re-randomization mask across surveys would let a proof
+            # observer cancel the masks and recover both permutations.
+            # Refill ahead of time with prewarm_dro().
+            pc_pool = getattr(self, "_shuffle_precomp", None)
+            if pc_pool is None:
+                pc_pool = self._shuffle_precomp = {}
+            for ci, cn in enumerate(self.cns):
                 key, k_sh = jax.random.split(key)
+                pc_key = (ci, int(n_cts.shape[0]))
+                pc = (pc_pool[pc_key].pop() if pc_pool.get(pc_key)
+                      else None)
+                if pc is None:
+                    key, k_pc = jax.random.split(key)
+                    pc = dro.precompute_rerandomization(
+                        k_pc, self.coll_tbl.table, int(n_cts.shape[0]))
                 out_cts, perm, rs = dro.shuffle_rerandomize(
-                    k_sh, n_cts, self.coll_tbl.table)
+                    k_sh, n_cts, self.coll_tbl.table, precomp=pc)
                 if proofs_on:
                     betas = [_limbs_to_int(r) for r in np.asarray(rs)]
                     pr = shuffle_proof.prove_shuffle(
@@ -358,8 +453,18 @@ class LocalCluster:
         for i in range(1, len(self.cns)):
             k_sum = B.g1_add(k_sum, u_pts[i])
             c_sum = B.g1_add(c_sum, w_pts[i])
-        switched = jnp.stack(
-            [k_sum, B.g1_add(agg[:, 1], c_sum)], axis=-3)
+        c2 = B.g1_add(agg[:, 1], c_sum)
+        if range_offset:
+            # subtract the public aggregate shift (n_dps * u^l/2) * B so the
+            # decrypted values are the true signed statistics
+            total = range_offset * len(self.dp_idents)
+            assert total < 2 ** 62, "offset too large for int64 scalar path"
+            corr = B.fixed_base_mul(
+                eg.BASE_TABLE.table,
+                B.int_to_scalar(jnp.asarray([total], dtype=jnp.int64)))
+            c2 = B.g1_add(c2, B.g1_neg(jnp.broadcast_to(
+                corr[0], c2.shape)))
+        switched = jnp.stack([k_sum, c2], axis=-3)
         switched.block_until_ready()
         tm.end("KeySwitchingPhase")
         if proofs_on:
@@ -367,9 +472,9 @@ class LocalCluster:
             pr = ks_proof.create_keyswitch_proofs(
                 k_kp, agg[:, 0], srv_x, ks_rs, self.client_pt,
                 self.client_tbl.table, u_pts, w_pts)
+            ks_bytes = _once(lambda: _pickle(pr))
             for cn in self.cns:
-                self._async_proof(survey, "keyswitch", cn,
-                                  lambda pr=pr: _pickle(pr))
+                self._async_proof(survey, "keyswitch", cn, ks_bytes)
 
         # --- Querier decrypt + decode -----------------------------------
         tm.start("Decryption")
@@ -389,6 +494,12 @@ class LocalCluster:
             w = np.asarray(lr.train(Ts, op.lr_params))
             tm.end("GradientDescent")
             result = w
+        elif q.group_by:
+            # per-group decode at the querier (reference api.go:124-128)
+            result = st.decode_grouped(
+                op.name, dec, st.group_grid(q.group_by),
+                op.query_min, op.query_max,
+                dims=(op.nbr_input - 1) if op.name == "lin_reg" else 1)
         else:
             result = st.decode(op.name, dec, op.query_min, op.query_max,
                                dims=(op.nbr_input - 1)
@@ -397,9 +508,17 @@ class LocalCluster:
         # --- VN finalization --------------------------------------------
         block = None
         if proofs_on:
+            # generous: on a cold CPU process the proof threads' FIRST run
+            # includes all pairing-kernel compiles (tens of minutes at
+            # opt-level 0 on one core; seconds on TPU)
             for t in survey.proof_threads:
-                t.join(timeout=600)
-            block = self.vns.end_verification(sq.survey_id, timeout=600)
+                t.join(timeout=2400)
+            block = self.vns.end_verification(sq.survey_id, timeout=2400)
+            log.lvl2(f"survey {sq.survey_id}: audit block "
+                     f"#{block.index} committed, "
+                     f"{len(block.data.bitmap)} bitmap entries")
+        log.lvl1(f"survey {sq.survey_id}: done; phases: " + ", ".join(
+            f"{k}={v:.3f}s" for k, v in tm.items()))
         return SurveyResult(result=result, decrypted=dec, block=block,
                             timers=tm, survey_id=sq.survey_id)
 
@@ -436,13 +555,24 @@ def _pickle(obj) -> bytes:
     return pickle.dumps(obj)
 
 
+def _once(build):
+    """Memoize a zero-arg builder across the per-CN async proof threads."""
+    lock = threading.Lock()
+    box: dict = {}
+
+    def get():
+        with lock:
+            if "v" not in box:
+                box["v"] = build()
+            return box["v"]
+
+    return get
+
+
 def _limbs_to_int(limbs: np.ndarray) -> int:
     from ..crypto import params
 
-    v = 0
-    for k in range(limbs.shape[-1] - 1, -1, -1):
-        v = (v << params.LIMB_BITS) | int(limbs[k])
-    return v
+    return params.from_limbs(limbs)
 
 
 __all__ = ["NodeIdentity", "DataProvider", "LocalCluster", "SurveyResult"]

@@ -3,14 +3,15 @@
 Grid semantics follow onet simulation runfiles: top-level keys are shared
 defaults, each [[run]] table overrides them for one run. Output: a list of
 result dicts + a CSV string whose columns are the phase taxonomy
-(SURVEY.md §5); run_file writes it next to the runfile (<name>.timedata.csv)
-unless csv_out overrides the path.
+(SURVEY.md §5); run_file(csv_out="auto") writes it next to the runfile
+(<name>.timedata.csv), csv_out=<path> writes there, None writes nothing.
 """
 from __future__ import annotations
 
 import dataclasses
 import io
 import time
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -43,6 +44,14 @@ class SimulationConfig:
         "diffpsize": "diffp_size", "diffpscale": "diffp_scale",
     }
 
+    # onet runfile boilerplate the reference tolerates (drynx_simul.go decodes
+    # into a struct, extra TOML keys are simply unused) — ignore silently.
+    _ONET_BOILERPLATE = {
+        "simulation", "hosts", "rounds", "bf", "servers", "suite",
+        "bandwidth", "delay", "runwait", "monitor", "debug", "singlehost",
+        "tls", "cuttingfactor",
+    }
+
     @classmethod
     def from_dict(cls, d: dict) -> "SimulationConfig":
         known = {f.name for f in dataclasses.fields(cls)}
@@ -51,8 +60,13 @@ class SimulationConfig:
             name = k.lower()
             name = cls._ALIASES.get(name.replace("_", ""), name)
             if name not in known:
-                raise ValueError(f"unknown simulation key {k!r} "
-                                 f"(known: {sorted(known)})")
+                if name.replace("_", "") in cls._ONET_BOILERPLATE:
+                    continue
+                # tolerate unknown keys like the reference, but surface them
+                # so near-miss typos (nbr_server) don't silently no-op
+                warnings.warn(f"ignoring unknown simulation key {k!r} "
+                              f"(known: {sorted(known)})")
+                continue
             out[name] = v
         return cls(**out)
 
@@ -100,7 +114,11 @@ def sq_out_size(cfg: SimulationConfig) -> int:
 
 
 def run_file(path: str, csv_out: Optional[str] = None) -> list[dict]:
-    """Run every [[run]] row of a TOML grid file (reference runfiles)."""
+    """Run every [[run]] row of a TOML grid file (reference runfiles).
+
+    csv_out: None = no CSV file (caller can use results_csv); "auto" = write
+    <runfile>.timedata.csv next to the runfile; any other string = that path.
+    """
     from ..cmd import toml_io
 
     with open(path) as f:
@@ -113,11 +131,12 @@ def run_file(path: str, csv_out: Optional[str] = None) -> list[dict]:
         merged = {**defaults, **row}
         results.append(run_simulation(SimulationConfig.from_dict(merged)))
 
-    if csv_out is None:
+    if csv_out == "auto":
         base = path[:-len(".toml")] if path.endswith(".toml") else path
         csv_out = base + ".timedata.csv"
-    with open(csv_out, "w") as f:
-        f.write(results_csv(results))
+    if csv_out is not None:
+        with open(csv_out, "w") as f:
+            f.write(results_csv(results))
     return results
 
 

@@ -1,0 +1,70 @@
+"""SPECTF-scale encrypted-LR benchmark (VERDICT task 5): 44 features, k=2
+-> V = 45 + 45^2 = 2070 ciphertexts per DP — the stress case for the einsum
+coefficient encoder and the dlog table. Reference baseline: 197 s total
+(exec 12.1 + proofs 180.6 + decode 4.1 — TIFS/logRegV2.py:9-14).
+
+Prints one JSON line (exec path; run on the TPU for the recorded number):
+  python scripts/bench_spectf.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+BASELINE_TOTAL_S = 197.0
+BASELINE_EXEC_S = 16.2   # exec 12.1 + decode 4.1
+
+
+def main():
+    import jax
+
+    from drynx_tpu import flagship
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.data import datasets
+    from drynx_tpu.models import logreg as lr
+
+    num_dps, n_servers = 10, 3
+    X, y = datasets.generate("spectf", seed=3)
+    # reference setting: 267 rows / 10 DPs; scale precision so the
+    # aggregated fixed-point coefficients stay inside the dlog table
+    params = lr.LRParams(
+        k=2, precision=0.1, lambda_=1.0, step=0.1, max_iterations=100,
+        n_features=X.shape[1], n_records=len(y), dtype="float32",
+        means=tuple(np.mean(X, 0)), std_devs=tuple(np.std(X, 0)))
+    assert params.num_coeffs() == 2070
+    setup = flagship.SurveySetup.create(n_servers=n_servers, dlog_limit=40000)
+    fn = jax.jit(flagship.build_pipeline(setup, params))
+
+    stats, enc_rs, _, k2 = flagship.make_inputs(
+        X, y.astype(np.int64), params, num_dps)
+    V = stats.shape[1]
+    ks_rs = eg.random_scalars(k2, (n_servers, V))
+
+    w, dec, found = fn(stats, enc_rs, ks_rs)
+    jax.block_until_ready(w)
+    assert bool(np.all(np.asarray(found))), "dlog table too small"
+    np.testing.assert_array_equal(np.asarray(dec),
+                                  np.asarray(stats).sum(axis=0))
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        w, dec, found = fn(stats, enc_rs, ks_rs)
+        jax.block_until_ready(w)
+        best = min(best, time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "encrypted_logreg_spectf_shaped_exec_seconds",
+        "value": round(best, 4),
+        "unit": "s",
+        "vs_exec_baseline": round(BASELINE_EXEC_S / best, 2),
+        "vs_total_baseline": round(BASELINE_TOTAL_S / best, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Capture the scaling grid (VERDICT task 8): run simul/runfiles/scaling.toml
+and commit the phase-timing CSV + formatted tables under simul/results/ so
+future rounds can diff against BASELINE.md's scaling rows.
+
+Usage: python scripts/run_scaling_grid.py [--runfile PATH] [--out DIR]
+(CPU by default — pass --tpu to run on the attached accelerator.)
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runfile", default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the default (accelerator) backend")
+    args = ap.parse_args()
+
+    if not args.tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_max_isa" not in flags:
+            flags += " --xla_cpu_max_isa=AVX2"
+        if "xla_backend_optimization_level" not in flags:
+            flags += " --xla_backend_optimization_level=0"
+        os.environ["XLA_FLAGS"] = flags.strip()
+
+    from drynx_tpu.simul import runner, timedata
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runfile = args.runfile or os.path.join(
+        here, "drynx_tpu", "simul", "runfiles", "scaling.toml")
+    outdir = args.out or os.path.join(here, "drynx_tpu", "simul", "results")
+    os.makedirs(outdir, exist_ok=True)
+
+    results = runner.run_file(runfile, csv_out=None)
+    csv = runner.results_csv(results)
+    base = os.path.splitext(os.path.basename(runfile))[0]
+    csv_path = os.path.join(outdir, base + ".timedata.csv")
+    with open(csv_path, "w") as f:
+        f.write(csv)
+
+    # one markdown row per grid run, aligned on the phase taxonomy
+    lines = ["| op | cns | dps | vns | " +
+             " | ".join(p for p in timedata.PHASES) + " |",
+             "|" + "---|" * (4 + len(timedata.PHASES))]
+    for r in results:
+        c, t = r["config"], r["timings"]
+        lines.append(
+            f"| {c['operation']} | {c['nbr_servers']} | {c['nbr_dps']} | "
+            f"{c['nbr_vns']} | " +
+            " | ".join(f"{t.get(p, 0.0):.3f}" for p in timedata.PHASES) +
+            " |")
+    table = "\n".join(lines) + "\n"
+    with open(os.path.join(outdir, base + ".table.md"), "w") as f:
+        f.write(table)
+    print(table)
+    print(json.dumps({"rows": len(results), "csv": csv_path}))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,8 @@ import subprocess
 
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy compiles; fast tier = -m 'not slow'
+
 TEST_DIR = pathlib.Path(__file__).resolve().parent.parent / "test"
 
 

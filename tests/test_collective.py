@@ -19,6 +19,8 @@ except ImportError:  # newer jax
 from drynx_tpu.crypto import elgamal as eg
 from drynx_tpu.parallel import collective as col
 
+pytestmark = pytest.mark.slow  # heavy compiles; fast tier = -m 'not slow'
+
 RNG = np.random.default_rng(21)
 NS = 8
 

@@ -16,6 +16,8 @@ import pytest
 from drynx_tpu.crypto import elgamal as eg
 from drynx_tpu.models import logreg as lr
 
+pytestmark = pytest.mark.slow  # heavy compiles; fast tier = -m 'not slow'
+
 RNG = np.random.default_rng(31)
 
 

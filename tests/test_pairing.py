@@ -11,6 +11,8 @@ from drynx_tpu.crypto import curve as C
 from drynx_tpu.crypto import field as F
 from drynx_tpu.crypto import params, refimpl
 
+pytestmark = pytest.mark.slow  # heavy compiles; fast tier = -m 'not slow'
+
 RNG = np.random.default_rng(41)
 
 

@@ -75,6 +75,18 @@ def test_fixed_base_kernel_matches_jnp():
     assert C.to_ref(out_pallas[1]) == refimpl.g1_mul(refimpl.G1, ss[1])
 
 
+def test_fixed_base_ladder_small_always_on():
+    """Always-on slice of the ladder kernel: n_windows=2 (k < 16^2) keeps the
+    interpret compile quick while still exercising the digit-decompose /
+    table-select / padd loop that the heavy tests cover in full."""
+    ss = [0, 1, 200]  # infinity edge + generator + 2-digit scalar
+    k = jnp.asarray(F.from_int(ss))
+    out_pallas = po.fixed_base_mul_flat(eg.BASE_TABLE.table, k, n_windows=2)
+    out_jnp = eg._fixed_base_mul_jnp(eg.BASE_TABLE.table, k, n_windows=2)
+    _assert_points_equal(out_pallas, out_jnp)
+    assert C.to_ref(out_pallas[2]) == refimpl.g1_mul(refimpl.G1, 200)
+
+
 def test_point_add_and_reduce_kernels():
     n = 3
     p, _ = _rand_points(n)

@@ -15,6 +15,8 @@ from drynx_tpu.crypto import field as F
 from drynx_tpu.crypto import params, refimpl
 from drynx_tpu.proofs import range_proof as rp
 
+pytestmark = pytest.mark.slow  # heavy compiles; fast tier = -m 'not slow'
+
 RNG = np.random.default_rng(7)
 U, L = 4, 3          # values in [0, 64)
 NS = 2               # servers

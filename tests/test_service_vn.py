@@ -51,7 +51,7 @@ def test_vn_group_collects_and_commits(tmp_path):
     dp_secret, dp_pub = eg.keygen(rng)
     pubs = {"dp0": dp_pub}
     vns = [VerifyingNode(f"vn{i}", str(tmp_path / f"vn{i}.db"), pubs,
-                         verify_fns={"aggregation": lambda d: d == b"good"},
+                         verify_fns={"aggregation": lambda d, _s: d == b"good"},
                          seed=i) for i in range(3)]
     group = VNGroup(vns)
     group.register_survey("sv", expected_proofs=2,

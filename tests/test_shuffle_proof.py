@@ -10,6 +10,8 @@ from drynx_tpu.crypto import field as F
 from drynx_tpu.crypto import params
 from drynx_tpu.proofs import shuffle as sp
 
+pytestmark = pytest.mark.slow  # heavy compiles; fast tier = -m 'not slow'
+
 RNG = np.random.default_rng(5)
 K = 5
 
