@@ -124,7 +124,8 @@ def cmd_survey_run(args) -> int:
     if sv.get("proofs"):
         result, block = client.run_survey(
             op, query_min=qmin, query_max=qmax, proofs=True,
-            obfuscation=bool(sv.get("obfuscation", False)))
+            obfuscation=bool(sv.get("obfuscation", False)),
+            timeout=float(sv.get("proof_timeout", 4800.0)))
         bitmap = block.get("bitmap", {})
         print(json.dumps({"operation": op, "result": _jsonable(result),
                           "block_hash": block.get("block_hash"),

@@ -119,11 +119,29 @@ def _build():
     g["g2_normalize"] = bucketed(G2.normalize, (3,), (2, 2, 0),
                                  min_bucket=32)
     g["fixed_base_mul"] = bucketed(eg.fixed_base_mul, (-1, 1), 2)
-    g["pair"] = bucketed(
-        lambda px, py, qx, qy: PAIR.pair((px, py), (qx, qy)),
-        (1, 1, 2, 2), 3, min_bucket=32)
-    g["gt_pow"] = bucketed(F12.pow_var, (3, 1), 3, min_bucket=32)
-    g["gt_mul"] = bucketed(F12.mul, (3, 3), 3, min_bucket=32)
+    from . import pallas_ops as po
+    from . import pallas_pairing as ppair
+
+    def _pair_fn(px, py, qx, qy):
+        # Mosaic pairing kernels on TPU (the jnp rolled-loop pairing runs
+        # seconds per batch on hardware — loop overhead, not compute)
+        if po.available():
+            return ppair.pair_flat(px, py, qx, qy)
+        return PAIR.pair((px, py), (qx, qy))
+
+    def _gt_pow_fn(f, k):
+        if po.available():
+            return ppair.f12_pow_flat(f, k)
+        return F12.pow_var(f, k)
+
+    def _gt_mul_fn(a, b):
+        if po.available():
+            return ppair.f12_mul_flat(a, b)
+        return F12.mul(a, b)
+
+    g["pair"] = bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32)
+    g["gt_pow"] = bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32)
+    g["gt_mul"] = bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32)
     g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32)
     g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1)
     g["fn_sub"] = bucketed(lambda a, b: F.sub(a, b, FN), (1, 1), 1)

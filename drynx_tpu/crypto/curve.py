@@ -205,11 +205,20 @@ def _scalar_mul_jnp(p, k_limbs):
 @jax.jit
 def normalize(p):
     """Jacobian -> affine: returns (x, y, is_inf). x,y Montgomery limbs."""
+    from . import pallas_ops as po
+
     X, Y, Z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
     inf = F.is_zero(Z)
     # avoid inv(0): substitute 1 for Z at infinity
     Zsafe = jnp.where(inf[..., None], FP.one_mont, Z)
-    Zi = F.batch_inv(Zsafe, FP)
+    if po.available():
+        # per-lane Fermat inversion kernel: the Montgomery-trick batch
+        # inversion scans sequentially over the BATCH axis (slow on TPU)
+        from . import pallas_pairing as ppair
+
+        Zi = ppair.fp_inv_flat(Zsafe.reshape(-1, 16)).reshape(Zsafe.shape)
+    else:
+        Zi = F.batch_inv(Zsafe, FP)
     Zi2 = F.mont_mul(Zi, Zi, FP)
     x = F.mont_mul(X, Zi2, FP)
     y = F.mont_mul(Y, F.mont_mul(Zi, Zi2, FP), FP)

@@ -114,9 +114,27 @@ def neg(p):
     return p.at[..., 1, :, :].set(F2.neg(p[..., 1, :, :]))
 
 
-@jax.jit
 def scalar_mul(p, k_limbs):
-    """k * Q, 256-step double-and-add-always scan (k: plain limbs (..., 16))."""
+    """k * Q (k: plain limbs (..., 16)). On TPU: the windowed Pallas ladder
+    kernel; elsewhere the 256-step double-and-add-always scan."""
+    from . import pallas_ops as po
+
+    if po.available():
+        from . import pallas_pairing as ppair
+
+        batch = jnp.broadcast_shapes(p.shape[:-3], k_limbs.shape[:-1])
+        pf = jnp.broadcast_to(p, batch + (3, 2, NUM_LIMBS)).reshape(
+            -1, 3, 2, NUM_LIMBS)
+        kf = jnp.broadcast_to(k_limbs, batch + (NUM_LIMBS,)).reshape(
+            -1, NUM_LIMBS)
+        return ppair.g2_scalar_mul_flat(pf, kf).reshape(
+            batch + (3, 2, NUM_LIMBS))
+    return _scalar_mul_jnp(p, k_limbs)
+
+
+@jax.jit
+def _scalar_mul_jnp(p, k_limbs):
+    """256-step double-and-add-always scan (portable fallback)."""
     bits = (k_limbs[..., :, None] >> jnp.arange(params.LIMB_BITS, dtype=jnp.uint32)) & 1
     bits = bits.reshape(bits.shape[:-2] + (256,))
     bits_t = jnp.moveaxis(bits, -1, 0)
@@ -139,10 +157,18 @@ def scalar_mul(p, k_limbs):
 @jax.jit
 def normalize(p):
     """Jacobian -> affine (x, y Fp2 Montgomery limbs, is_inf)."""
+    from . import pallas_ops as po
+
     X, Y, Z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
     inf = is_infinity(p)
     Zsafe = jnp.where(inf[..., None, None], F2.one(), Z)
-    Zi = F2.inv(Zsafe)
+    if po.available():
+        from . import pallas_pairing as ppair
+
+        Zi = ppair.f2_inv_flat(
+            Zsafe.reshape(-1, 2, NUM_LIMBS)).reshape(Zsafe.shape)
+    else:
+        Zi = F2.inv(Zsafe)
     Zi2 = F2.sqr(Zi)
     x = F2.mul(X, Zi2)
     y = F2.mul(Y, F2.mul(Zi, Zi2))

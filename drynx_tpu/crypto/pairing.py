@@ -373,7 +373,8 @@ def _frob3(f):
 
 
 def pair(p_aff, q_aff):
-    """Reduced Tate pairing, batched. Infinity handling is the caller's
+    """Reduced OPTIMAL ATE pairing, batched (the Tate loop survives as
+    miller_loop_tate for cross-checks). Infinity handling is the caller's
     concern (use select against F12.one())."""
     return final_exp(miller_loop(p_aff, q_aff))
 

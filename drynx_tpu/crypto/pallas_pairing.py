@@ -1,0 +1,926 @@
+"""Pallas TPU kernels for the pairing pipeline (optimal ate + final exp).
+
+Why: the jnp pairing (crypto/pairing.py) is correct but its rolled limb
+loops execute as nested XLA while-loops — ~2.7-5.5s per Miller batch on the
+chip regardless of batch size (loop overhead, not compute). Range-proof
+creation/verification dispatches tens of thousands of pairings (reference
+cost center: lib/range/range_proof.go:504-565, 21.7 s VN phase), so the
+pairing must run like the scalar-mul ladders: whole loop inside one Mosaic
+kernel, limbs on sublanes, batch on lanes (see crypto/pallas_ops.py).
+
+Kernels:
+  miller_flat(p, q)        optimal ate Miller function, batched
+  f12_mul_flat(a, b)       one Fp12 product (final-exp glue)
+  f12_inv_flat(f)          Fp12 inversion (tower + in-kernel Fermat Fp inv)
+  f12_pow_flat(f, k, n)    f^k, square-and-multiply-always over n bit rows
+  pair_flat(px, py, qx, qy)  full reduced pairing (miller + final exp),
+                           final-exp Frobenius/Olivos glue at jnp level
+
+Math mirrors crypto/pairing.py exactly (same line sparsity {0,1,3}, same
+DSD/Olivos hard part); parity is asserted against it in
+tests/test_pallas_pairing.py via interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import params
+from .pallas_ops import (INTERPRET, LANES, MASK, NL, _M_FP, _NPRIME_FP,
+                         _pad_lanes, fadd, fsub, mont_mul)
+
+_XI_A = params.XI[0]          # XI = (3, 1): (x0+x1 i)(3+i)
+assert params.XI[1] == 1
+
+_ATE_BITS = [int(b) for b in bin(6 * params.U + 2)[3:]]   # MSB-first, 65
+_U_BITS_LSB = [(params.U >> i) & 1 for i in range(params.U.bit_length())]
+_PM2_BITS = [int(b) for b in bin(params.P - 2)[2:]]       # MSB-first
+
+
+# ---------------------------------------------------------------------------
+# In-kernel Fp2 / Fp12 arithmetic on (16, B) limb tiles
+# ---------------------------------------------------------------------------
+
+def make_fp2(m, nprime):
+    mul = lambda a, b: mont_mul(a, b, m, nprime)
+    add = lambda a, b: fadd(a, b, m)
+    sub = lambda a, b: fsub(a, b, m)
+
+    def f2add(a, b):
+        return (add(a[0], b[0]), add(a[1], b[1]))
+
+    def f2sub(a, b):
+        return (sub(a[0], b[0]), sub(a[1], b[1]))
+
+    def f2neg(a):
+        z = jnp.zeros_like(a[0])
+        return (sub(z, a[0]), sub(z, a[1]))
+
+    def f2conj(a):
+        z = jnp.zeros_like(a[1])
+        return (a[0], sub(z, a[1]))
+
+    def f2mul(a, b):
+        # Karatsuba over i^2 = -1: 3 Montgomery muls
+        t0 = mul(a[0], b[0])
+        t1 = mul(a[1], b[1])
+        t2 = mul(add(a[0], a[1]), add(b[0], b[1]))
+        return (sub(t0, t1), sub(sub(t2, t0), t1))
+
+    def f2sqr(a):
+        re = mul(add(a[0], a[1]), sub(a[0], a[1]))
+        im2 = mul(a[0], a[1])
+        return (re, add(im2, im2))
+
+    def f2mul_fp(a, s):
+        return (mul(a[0], s), mul(a[1], s))
+
+    def _mul3(x):
+        return add(add(x, x), x)
+
+    def f2mul_xi(a):
+        # (x0 + x1 i)(3 + i) = (3x0 - x1) + (x0 + 3x1) i
+        return (sub(_mul3(a[0]), a[1]), add(a[0], _mul3(a[1])))
+
+    return dict(add=f2add, sub=f2sub, neg=f2neg, conj=f2conj, mul=f2mul,
+                sqr=f2sqr, mul_fp=f2mul_fp, mul_xi=f2mul_xi,
+                fp_mul=mul, fp_add=add, fp_sub=sub)
+
+
+def make_fp12(F2):
+    """Fp12 = 6-list of Fp2 pairs; flat tower w^6 = XI (crypto/fp12.py)."""
+
+    def f12mul(a, b):
+        cs = [None] * 11
+        for j in range(6):
+            for k in range(6):
+                t = F2["mul"](a[j], b[k])
+                cs[j + k] = t if cs[j + k] is None else F2["add"](cs[j + k], t)
+        out = list(cs[:6])
+        for k in range(6, 11):
+            out[k - 6] = F2["add"](out[k - 6], F2["mul_xi"](cs[k]))
+        return out
+
+    def f12sqr(a):
+        return f12mul(a, a)
+
+    def f12conj6(a):
+        return [a[k] if k % 2 == 0 else F2["neg"](a[k]) for k in range(6)]
+
+    # Fp6 helpers on Fp2 triples (crypto/fp12.py:66-110)
+    def fp6_mul(a, b):
+        t00 = F2["mul"](a[0], b[0])
+        t11 = F2["mul"](a[1], b[1])
+        t22 = F2["mul"](a[2], b[2])
+        c0 = F2["add"](t00, F2["mul_xi"](
+            F2["add"](F2["mul"](a[1], b[2]), F2["mul"](a[2], b[1]))))
+        c1 = F2["add"](F2["add"](F2["mul"](a[0], b[1]), F2["mul"](a[1], b[0])),
+                       F2["mul_xi"](t22))
+        c2 = F2["add"](F2["add"](F2["mul"](a[0], b[2]), F2["mul"](a[2], b[0])),
+                       t11)
+        return (c0, c1, c2)
+
+    def fp6_sub(a, b):
+        return tuple(F2["sub"](x, y) for x, y in zip(a, b))
+
+    def fp6_mul_v(a):
+        return (F2["mul_xi"](a[2]), a[0], a[1])
+
+    def fp_inv(x):
+        """x^(p-2) — Fermat inversion, static square-and-multiply chain."""
+        acc = x
+        for bit in _PM2_BITS[1:]:
+            acc = F2["fp_mul"](acc, acc)
+            if bit:
+                acc = F2["fp_mul"](acc, x)
+        return acc
+
+    def f2inv(a):
+        n = F2["fp_add"](F2["fp_mul"](a[0], a[0]), F2["fp_mul"](a[1], a[1]))
+        ni = fp_inv(n)
+        z = jnp.zeros_like(a[1])
+        return (F2["fp_mul"](a[0], ni),
+                F2["fp_mul"](F2["fp_sub"](z, a[1]), ni))
+
+    def fp6_inv(a):
+        a0, a1, a2 = a
+        c0 = F2["sub"](F2["sqr"](a0), F2["mul_xi"](F2["mul"](a1, a2)))
+        c1 = F2["sub"](F2["mul_xi"](F2["sqr"](a2)), F2["mul"](a0, a1))
+        c2 = F2["sub"](F2["sqr"](a1), F2["mul"](a0, a2))
+        t = F2["add"](F2["mul"](a0, c0), F2["mul_xi"](
+            F2["add"](F2["mul"](a1, c2), F2["mul"](a2, c1))))
+        ti = f2inv(t)
+        return (F2["mul"](c0, ti), F2["mul"](c1, ti), F2["mul"](c2, ti))
+
+    def f12inv(f):
+        a = (f[0], f[2], f[4])
+        b = (f[1], f[3], f[5])
+        norm = fp6_sub(fp6_mul(a, a), fp6_mul_v(fp6_mul(b, b)))
+        ninv = fp6_inv(norm)
+        ra = fp6_mul(a, ninv)
+        rb = fp6_mul(b, ninv)
+        rb = tuple(F2["neg"](x) for x in rb)
+        return [ra[0], rb[0], ra[1], rb[1], ra[2], rb[2]]
+
+    def sparse013(f, l0, l1, l3):
+        acc = [None] * 9
+
+        def accum(k, v):
+            acc[k] = v if acc[k] is None else F2["add"](acc[k], v)
+
+        for k in range(6):
+            accum(k, F2["mul"](f[k], l0))
+            accum(k + 1, F2["mul"](f[k], l1))
+            accum(k + 3, F2["mul"](f[k], l3))
+        out = list(acc[:6])
+        for k in range(6, 9):
+            out[k - 6] = F2["add"](out[k - 6], F2["mul_xi"](acc[k]))
+        return out
+
+    return dict(mul=f12mul, sqr=f12sqr, conj6=f12conj6, inv=f12inv,
+                sparse013=sparse013)
+
+
+def _f12_load(ref):
+    """(12, 16, B) ref -> 6-list of Fp2 pairs of (16, B)."""
+    return [(ref[2 * k], ref[2 * k + 1]) for k in range(6)]
+
+
+def _f12_store(o_ref, f):
+    for k in range(6):
+        o_ref[2 * k] = f[k][0]
+        o_ref[2 * k + 1] = f[k][1]
+
+
+def _f12_one_tiles(one_col, B):
+    """Fp12 one from a (16, 1) Montgomery-one column (kernel input — Mosaic
+    rejects captured host arrays; see pallas_ops module docstring)."""
+    rows = [jnp.broadcast_to(one_col, (NL, B))]
+    rows += [jnp.zeros((NL, B), jnp.uint32)] * 11
+    return [(rows[2 * k], rows[2 * k + 1]) for k in range(6)]
+
+
+def _f12_select(cond, a, b):
+    """Per-lane select between two Fp12 values; cond (B,) bool."""
+    c = cond[None, :]
+    return [(jnp.where(c, x[0], y[0]), jnp.where(c, x[1], y[1]))
+            for x, y in zip(a, b)]
+
+
+# ---------------------------------------------------------------------------
+# Miller loop kernel
+# ---------------------------------------------------------------------------
+
+def _miller_kernel(m_ref, np_ref, g_ref, bits_ref, p_ref, q_ref, o_ref):
+    """Optimal ate Miller function (mirrors pairing.miller_loop).
+
+    g_ref: (16, 8) — the three G2-Frobenius Fp2 constants (g12, g13, g22)
+    as limb columns, then (one_mont, 0). p_ref: (2, 16, B) G1 affine
+    Montgomery; q_ref: (10, 16, B): xq, yq, then the host-precomputed Frobenius
+    images q1x, q1y, nq2x (each an Fp2 pair of rows).
+    """
+    m = m_ref[:]
+    nprime = np_ref[0, 0]
+    F2 = make_fp2(m, nprime)
+    F12 = make_fp12(F2)
+
+    B = p_ref.shape[-1]
+    xp, yp = p_ref[0], p_ref[1]
+    xq = (q_ref[0], q_ref[1])
+    yq = (q_ref[2], q_ref[3])
+    # Frobenius images of Q are precomputed host-side (constant-broadcast
+    # multiplications inside the kernel hit an unimplemented Mosaic
+    # sublane+lane broadcast when mixed with trace-level constant folding)
+    q1x = (q_ref[4], q_ref[5])
+    q1y = (q_ref[6], q_ref[7])
+    nq2x = (q_ref[8], q_ref[9])
+
+    # constants live in a 2D (limbs x columns) block; slicing a lane column
+    # then broadcasting is the Mosaic-supported pattern (see the fixed-base
+    # kernel's table select)
+    one_m = jnp.broadcast_to(g_ref[:, 6:7], (NL, B))
+
+
+    def dbl_step(T, f):
+        X, Y, Z = T
+        A = F2["sqr"](X)
+        Bv = F2["sqr"](Y)
+        zz = F2["sqr"](Z)
+        E = F2["add"](F2["add"](A, A), A)              # 3X^2
+        AX = F2["mul"](A, X)                           # X^3
+        l3 = F2["sub"](F2["add"](F2["add"](AX, AX), AX),
+                       F2["add"](Bv, Bv))              # 3X^3 - 2Y^2
+        l1 = F2["mul_fp"](F2["neg"](F2["mul"](E, zz)), xp)
+        YZ = F2["mul"](Y, Z)
+        YZ3 = F2["mul"](YZ, zz)
+        l0 = F2["mul_fp"](F2["add"](YZ3, YZ3), yp)
+        # point double (same formulas as pallas_ops.make_group pdouble)
+        Cv = F2["sqr"](Bv)
+        t0 = F2["add"](X, Bv)
+        t = F2["sub"](F2["sqr"](t0), F2["add"](A, Cv))
+        D = F2["add"](t, t)
+        Fv = F2["sqr"](E)
+        X3 = F2["sub"](Fv, F2["add"](D, D))
+        C2 = F2["add"](Cv, Cv)
+        C8 = F2["add"](F2["add"](C2, C2), F2["add"](C2, C2))
+        Y3 = F2["sub"](F2["mul"](E, F2["sub"](D, X3)), C8)
+        Z3 = F2["add"](YZ, YZ)
+        f = F12["sqr"](f)
+        f = F12["sparse013"](f, l0, l1, l3)
+        return (X3, Y3, Z3), f
+
+    def add_step(T, f, qx, qy):
+        """Mixed add T + (qx, qy) with the line through them; the whole line
+        may be scaled by any Fp2 factor (killed by the final exponentiation),
+        so the madd-convention sign flip is free (pairing.py's line times -1:
+        l0 = Hm Z yp, l1 = -r1 xp, l3 = r1 xq - Hm Z yq)."""
+        X1, Y1, Z1 = T
+        zz = F2["sqr"](Z1)
+        U2 = F2["mul"](qx, zz)
+        S2 = F2["mul"](qy, F2["mul"](Z1, zz))
+        Hm = F2["sub"](U2, X1)
+        r1 = F2["sub"](S2, Y1)
+        HmZ = F2["mul"](Hm, Z1)
+        l0 = F2["mul_fp"](HmZ, yp)
+        l1 = F2["mul_fp"](F2["neg"](r1), xp)
+        l3 = F2["sub"](F2["mul"](r1, qx), F2["mul"](HmZ, qy))
+        f2 = F12["sparse013"](f, l0, l1, l3)
+        # madd-2007-bl point addition
+        HH = F2["sqr"](Hm)
+        I4 = F2["add"](F2["add"](HH, HH), F2["add"](HH, HH))
+        J = F2["mul"](Hm, I4)
+        rm = F2["add"](r1, r1)
+        V = F2["mul"](X1, I4)
+        X3 = F2["sub"](F2["sub"](F2["sqr"](rm), J), F2["add"](V, V))
+        YJ = F2["mul"](Y1, J)
+        Y3 = F2["sub"](F2["mul"](rm, F2["sub"](V, X3)), F2["add"](YJ, YJ))
+        Z3 = F2["sub"](F2["sub"](F2["sqr"](F2["add"](Z1, Hm)), zz), HH)
+        return (X3, Y3, Z3), f2
+
+    T0 = (xq, yq, (one_m, jnp.zeros((NL, B), jnp.uint32)))
+    f0 = _f12_one_tiles(g_ref[:, 6:7], B)
+
+    def body(w, state):
+        T, f = state
+        T, f = dbl_step(T, f)
+        # bits are pre-broadcast to lanes (scalar->tile broadcasts hit an
+        # unimplemented Mosaic "broadcast in both sublanes and lanes" path)
+        bit = bits_ref[pl.ds(w, 1), :][0]          # (B,)
+        Ta, fa = add_step(T, f, xq, yq)
+        cond = bit == 1
+        T = tuple((jnp.where(cond[None, :], a[0], b[0]),
+                   jnp.where(cond[None, :], a[1], b[1]))
+                  for a, b in zip(Ta, T))
+        f = _f12_select(cond, fa, f)
+        return (T, f)
+
+    T, f = jax.lax.fori_loop(jnp.int32(0), jnp.int32(len(_ATE_BITS)), body,
+                             (T0, f0))
+
+    # Frobenius corrections: Q1 = (conj(xq)*g12, conj(yq)*g13);
+    # -pi^2(Q) = (xq*g22, yq)  [XI non-square => XI^((p^2-1)/2) = -1]
+    T, f = add_step(T, f, q1x, q1y)
+    _, f = add_step(T, f, nq2x, yq)
+    _f12_store(o_ref, f)
+
+
+def _twist_frob_tiles() -> np.ndarray:
+    """(16, 8): columns = g12_0, g12_1, g13_0, g13_1, g22_0, g22_1 (the G2
+    Frobenius Fp2 constants), one_mont, 0 — Montgomery limbs on sublanes."""
+    from . import refimpl
+
+    cols = []
+    for c in (refimpl._G12, refimpl._G13, refimpl._G22):
+        for comp in c:
+            cols.append(np.asarray(
+                params.to_limbs(comp * params.R % params.P), dtype=np.uint32))
+    cols.append(np.asarray(params.to_limbs(params.R % params.P),
+                           dtype=np.uint32))
+    cols.append(np.zeros(NL, dtype=np.uint32))
+    return np.stack(cols, axis=-1)
+
+
+@jax.jit
+def miller_flat(px, py, qx, qy):
+    """Batched ate Miller function.
+
+    px, py: (N, 16) Fp Montgomery; qx, qy: (N, 2, 16) Fp2 Montgomery.
+    Returns (N, 6, 2, 16) unreduced Miller value (host layout).
+    """
+    from . import fp2 as F2j
+
+    N = px.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    p_in = _pad_lanes(jnp.stack([px.T, py.T]), Np)            # (2, 16, Np)
+    # host-side Frobenius images of Q (refimpl.twist_frob semantics)
+    g12, g13, g22 = _twist_frob_consts_jnp()
+    q1x = F2j.mul(F2j.conj(qx), g12)
+    q1y = F2j.mul(F2j.conj(qy), g13)
+    nq2x = F2j.mul(qx, g22)
+    q_in = _pad_lanes(jnp.concatenate(
+        [jnp.transpose(t, (1, 2, 0))
+         for t in (qx, qy, q1x, q1y, nq2x)], axis=0), Np)     # (10, 16, Np)
+    m_in = jnp.asarray(_M_FP[:, None])
+    np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
+    g_in = jnp.asarray(_twist_frob_tiles())
+    bits_in = jnp.asarray(np.broadcast_to(
+        np.asarray(_ATE_BITS, dtype=np.uint32)[:, None],
+        (len(_ATE_BITS), LANES)).copy())
+
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _miller_kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((NL, 8), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((len(_ATE_BITS), LANES), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((2, NL, LANES), lambda i: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((10, NL, LANES), lambda i: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((12, NL, LANES), lambda i: (0, 0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((12, NL, Np), jnp.uint32),
+            interpret=INTERPRET,
+        )(m_in, np_in, g_in, bits_in, p_in, q_in)
+    return jnp.transpose(out, (2, 0, 1))[:N].reshape(N, 6, 2, NL)
+
+
+_TF_JNP = None
+
+
+def _twist_frob_consts_jnp():
+    global _TF_JNP
+    if _TF_JNP is None:
+        from . import fp2 as F2j
+        from . import refimpl
+
+        # cache NUMPY (a jnp array materialized inside a jit trace is a
+        # tracer — caching it across calls leaks it out of the trace)
+        _TF_JNP = tuple(np.asarray(F2j.from_ref(c))
+                        for c in (refimpl._G12, refimpl._G13, refimpl._G22))
+    return _TF_JNP
+
+
+# ---------------------------------------------------------------------------
+# Fp12 mul / inv / pow kernels (final-exp building blocks + GT ops)
+# ---------------------------------------------------------------------------
+
+def _f12_mul_kernel(m_ref, np_ref, a_ref, b_ref, o_ref):
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    F12 = make_fp12(F2)
+    _f12_store(o_ref, F12["mul"](_f12_load(a_ref), _f12_load(b_ref)))
+
+
+def _f12_inv_kernel(m_ref, np_ref, a_ref, o_ref):
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    F12 = make_fp12(F2)
+    _f12_store(o_ref, F12["inv"](_f12_load(a_ref)))
+
+
+def _f12_pow_kernel(m_ref, np_ref, one_ref, f_ref, k_ref, o_ref, bit_ref,
+                    *, n_bits: int):
+    """f^k, LSB-first square-and-multiply-always over n_bits bit rows.
+    one_ref: (16, 1) Montgomery-one column for the Fp12 identity."""
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    F12 = make_fp12(F2)
+    B = f_ref.shape[-1]
+    k = k_ref[:]
+
+    rows = []
+    for w in range(n_bits):
+        limb, s = divmod(w, params.LIMB_BITS)
+        rows.append((k[limb] >> np.uint32(s)) & np.uint32(1))
+    bit_ref[:] = jnp.stack(rows)                 # (n_bits, B)
+
+    base0 = _f12_load(f_ref)
+    acc0 = _f12_one_tiles(one_ref[:], B)
+
+    def body(w, state):
+        acc, base = state
+        bit = bit_ref[pl.ds(w, 1), :][0]
+        acc2 = F12["mul"](acc, base)
+        acc = _f12_select(bit == 1, acc2, acc)
+        base = F12["sqr"](base)
+        return (acc, base)
+
+    acc, _ = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_bits), body,
+                               (acc0, base0))
+    _f12_store(o_ref, acc)
+
+
+def _f12_slotmul_kernel(m_ref, np_ref, c_ref, a_ref, o_ref,
+                        *, conj_fp2: bool):
+    """out[k] = (conj(a[k]) if conj_fp2 else a[k]) * c[k] — the shape of
+    every Frobenius power on the flat tower (pairing._frob1/2/3) and of
+    conj6 (constants (+-1)^k, conj_fp2=False). c_ref: (12, 16, LANES),
+    constants pre-broadcast across lanes on the host (in-kernel constant
+    broadcasts hit the unimplemented Mosaic sublane+lane path)."""
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    f = _f12_load(a_ref)
+    out = []
+    for k in range(6):
+        c = (c_ref[2 * k], c_ref[2 * k + 1])
+        x = F2["conj"](f[k]) if conj_fp2 else f[k]
+        out.append(F2["mul"](x, c))
+    _f12_store(o_ref, out)
+
+
+_FROB_TILES = {}
+
+
+def _frob_tiles(which) -> np.ndarray:
+    """(12, 16, LANES) Montgomery Fp2 constants for frob1/2/3 or conj6,
+    pre-broadcast across lanes."""
+    if which in _FROB_TILES:
+        return _FROB_TILES[which]
+    from . import refimpl
+
+    if which == "conj6":
+        consts = [(1, 0) if k % 2 == 0 else (params.P - 1, 0)
+                  for k in range(6)]
+    else:
+        e = {"frob1": 1, "frob2": 2, "frob3": 3}[which]
+        g = refimpl.fp2_pow(params.XI, (params.P ** e - 1) // 6)
+        consts, cur = [], (1, 0)
+        for _k in range(6):
+            consts.append(cur)
+            cur = refimpl.fp2_mul(cur, g)
+    rows = []
+    for c in consts:
+        for comp in c:
+            rows.append(np.asarray(
+                params.to_limbs(comp * params.R % params.P), dtype=np.uint32))
+    _FROB_TILES[which] = np.broadcast_to(
+        np.stack(rows)[:, :, None], (12, NL, LANES)).copy()
+    return _FROB_TILES[which]
+
+
+@functools.partial(jax.jit, static_argnames="which")
+def f12_slotmul_flat(a, which: str):
+    """Frobenius^e / conj6 on (N, 6, 2, 16): which in
+    {frob1, frob2, frob3, conj6}."""
+    N = a.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    m_in, np_in = _mnp()
+    c_in = jnp.asarray(_frob_tiles(which))
+    io = _f12_io(n_tiles, Np, 1)
+    io["in_specs"].insert(2, pl.BlockSpec((12, NL, LANES),
+                                          lambda i: (0, 0, 0),
+                                          memory_space=pltpu.VMEM))
+    conj_fp2 = which in ("frob1", "frob3")
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_f12_slotmul_kernel, conj_fp2=conj_fp2),
+            interpret=INTERPRET, **io)(m_in, np_in, c_in, _to_tiles(a, Np))
+    return _from_tiles(out, N)
+
+
+def _f12_io(n_tiles, Np, n_inputs):
+    specs = [
+        pl.BlockSpec((NL, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+    ]
+    specs += [pl.BlockSpec((12, NL, LANES), lambda i: (0, 0, i),
+                           memory_space=pltpu.VMEM)] * n_inputs
+    return dict(
+        grid=(n_tiles,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((12, NL, LANES), lambda i: (0, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((12, NL, Np), jnp.uint32),
+    )
+
+
+def _to_tiles(f, Np):
+    """(N, 6, 2, 16) -> (12, 16, Np)."""
+    N = f.shape[0]
+    return _pad_lanes(jnp.transpose(f.reshape(N, 12, NL), (1, 2, 0)), Np)
+
+
+def _from_tiles(t, N):
+    return jnp.transpose(t, (2, 0, 1))[:N].reshape(N, 6, 2, NL)
+
+
+def _mnp():
+    return (jnp.asarray(_M_FP[:, None]),
+            jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32))
+
+
+@jax.jit
+def f12_mul_flat(a, b):
+    """(N, 6, 2, 16) x (N, 6, 2, 16) -> (N, 6, 2, 16)."""
+    N = a.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    m_in, np_in = _mnp()
+    with jax.enable_x64(False):
+        out = pl.pallas_call(_f12_mul_kernel, interpret=INTERPRET,
+                             **_f12_io(n_tiles, Np, 2))(
+            m_in, np_in, _to_tiles(a, Np), _to_tiles(b, Np))
+    return _from_tiles(out, N)
+
+
+@jax.jit
+def f12_inv_flat(a):
+    N = a.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    m_in, np_in = _mnp()
+    with jax.enable_x64(False):
+        out = pl.pallas_call(_f12_inv_kernel, interpret=INTERPRET,
+                             **_f12_io(n_tiles, Np, 1))(
+            m_in, np_in, _to_tiles(a, Np))
+    return _from_tiles(out, N)
+
+
+@functools.partial(jax.jit, static_argnames="n_bits")
+def f12_pow_flat(f, k, n_bits: int = 256):
+    """f^k batched: f (N, 6, 2, 16), k (N, 16) plain limbs (LSB-first bits;
+    n_bits < 256 truncates for exponents known to be short, e.g. |u| = 63)."""
+    N = f.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    m_in, np_in = _mnp()
+    one_in = jnp.asarray(np.asarray(
+        params.to_limbs(params.R % params.P), dtype=np.uint32)[:, None])
+    kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)
+    io = _f12_io(n_tiles, Np, 1)
+    # insert the one-column spec BEFORE the f12 input, append the exponent
+    io["in_specs"].insert(2, pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                                          memory_space=pltpu.VMEM))
+    io["in_specs"].append(pl.BlockSpec((NL, LANES), lambda i: (0, i),
+                                       memory_space=pltpu.VMEM))
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_f12_pow_kernel, n_bits=n_bits),
+            scratch_shapes=[pltpu.VMEM((n_bits, LANES), jnp.uint32)],
+            interpret=INTERPRET, **io)(
+            m_in, np_in, one_in, _to_tiles(f, Np), kt)
+    return _from_tiles(out, N)
+
+
+# ---------------------------------------------------------------------------
+# Field inversion kernels (Fermat chains; replace the sequential
+# Montgomery-trick batch inversion, which scans over the BATCH axis and
+# crawls on TPU) + G2 windowed scalar-mult ladder
+# ---------------------------------------------------------------------------
+
+def _fp_inv_kernel(m_ref, np_ref, x_ref, o_ref):
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    x = x_ref[:]
+    acc = x
+    for bit in _PM2_BITS[1:]:
+        acc = F2["fp_mul"](acc, acc)
+        if bit:
+            acc = F2["fp_mul"](acc, x)
+    o_ref[:] = acc
+
+
+@jax.jit
+def fp_inv_flat(x):
+    """x^(p-2) batched: (N, 16) Montgomery -> (N, 16) Montgomery."""
+    N = x.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    m_in, np_in = _mnp()
+    xt = _pad_lanes(jnp.transpose(x, (1, 0)), Np)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _fp_inv_kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((NL, LANES), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((NL, LANES), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((NL, Np), jnp.uint32),
+            interpret=INTERPRET,
+        )(m_in, np_in, xt)
+    return jnp.transpose(out, (1, 0))[:N]
+
+
+def _f2_inv_kernel(m_ref, np_ref, a_ref, o_ref):
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    a = (a_ref[0], a_ref[1])
+    # norm = a0^2 + a1^2; inv via Fermat; out = (a0*ni, -a1*ni)
+    n = F2["fp_add"](F2["fp_mul"](a[0], a[0]), F2["fp_mul"](a[1], a[1]))
+    acc = n
+    for bit in _PM2_BITS[1:]:
+        acc = F2["fp_mul"](acc, acc)
+        if bit:
+            acc = F2["fp_mul"](acc, n)
+    z = jnp.zeros_like(a[1])
+    o_ref[0] = F2["fp_mul"](a[0], acc)
+    o_ref[1] = F2["fp_mul"](F2["fp_sub"](z, a[1]), acc)
+
+
+@jax.jit
+def f2_inv_flat(a):
+    """Fp2 inverse batched: (N, 2, 16) Montgomery -> (N, 2, 16)."""
+    N = a.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    m_in, np_in = _mnp()
+    at = _pad_lanes(jnp.transpose(a, (1, 2, 0)), Np)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _f2_inv_kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((2, NL, LANES), lambda i: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((2, NL, LANES), lambda i: (0, 0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((2, NL, Np), jnp.uint32),
+            interpret=INTERPRET,
+        )(m_in, np_in, at)
+    return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+def _f2_is_zero(a):
+    from .pallas_ops import fis_zero
+
+    return fis_zero(a[0]) & fis_zero(a[1])
+
+
+def make_g2_group(F2):
+    """Complete Jacobian group law on the twist (Fp2 tiles); mirrors
+    pallas_ops.make_group with Fp2 arithmetic and crypto/g2.py formulas."""
+
+    def sel(cond, p, q):
+        c = cond[None, :]
+        return tuple((jnp.where(c, a[0], b[0]), jnp.where(c, a[1], b[1]))
+                     for a, b in zip(p, q))
+
+    def inf_like(p):
+        one = jnp.ones((1,) + p[0][0].shape[1:], jnp.uint32)
+        zeros = jnp.zeros((NL - 1,) + p[0][0].shape[1:], jnp.uint32)
+        X0 = jnp.concatenate([one, zeros], axis=0)
+        zt = jnp.zeros_like(p[0][0])
+        return ((X0, zt), (X0, zt), (zt, zt))
+
+    def pdouble(p):
+        X, Y, Z = p
+        A = F2["sqr"](X)
+        Bv = F2["sqr"](Y)
+        Cv = F2["sqr"](Bv)
+        t = F2["sub"](F2["sqr"](F2["add"](X, Bv)), F2["add"](A, Cv))
+        D = F2["add"](t, t)
+        E = F2["add"](F2["add"](A, A), A)
+        Fv = F2["sqr"](E)
+        X3 = F2["sub"](Fv, F2["add"](D, D))
+        C2 = F2["add"](Cv, Cv)
+        C8 = F2["add"](F2["add"](C2, C2), F2["add"](C2, C2))
+        Y3 = F2["sub"](F2["mul"](E, F2["sub"](D, X3)), C8)
+        YZ = F2["mul"](Y, Z)
+        Z3 = F2["add"](YZ, YZ)
+        return (X3, Y3, Z3)
+
+    def padd(p, q):
+        X1, Y1, Z1 = p
+        X2, Y2, Z2 = q
+        Z1Z1 = F2["sqr"](Z1)
+        Z2Z2 = F2["sqr"](Z2)
+        U1 = F2["mul"](X1, Z2Z2)
+        U2 = F2["mul"](X2, Z1Z1)
+        S1 = F2["mul"](Y1, F2["mul"](Z2, Z2Z2))
+        S2 = F2["mul"](Y2, F2["mul"](Z1, Z1Z1))
+        H = F2["sub"](U2, U1)
+        HH = F2["add"](H, H)
+        I = F2["sqr"](HH)
+        J = F2["mul"](H, I)
+        r = F2["sub"](S2, S1)
+        r = F2["add"](r, r)
+        V = F2["mul"](U1, I)
+        X3 = F2["sub"](F2["sub"](F2["sqr"](r), J), F2["add"](V, V))
+        SJ = F2["mul"](S1, J)
+        Y3 = F2["sub"](F2["mul"](r, F2["sub"](V, X3)), F2["add"](SJ, SJ))
+        t1 = F2["add"](Z1, Z2)
+        ZZ = F2["sub"](F2["sub"](F2["sqr"](t1), Z1Z1), Z2Z2)
+        Z3 = F2["mul"](ZZ, H)
+        res = (X3, Y3, Z3)
+
+        p_inf = _f2_is_zero(Z1)
+        q_inf = _f2_is_zero(Z2)
+        h0 = _f2_is_zero(H)
+        r0 = _f2_is_zero(r)
+        res = sel(h0 & r0 & ~p_inf & ~q_inf, pdouble(p), res)
+        res = sel(h0 & ~r0 & ~p_inf & ~q_inf, inf_like(p), res)
+        res = sel(q_inf, p, res)
+        res = sel(p_inf, q, res)
+        return res
+
+    return pdouble, padd, inf_like
+
+
+def _g2_scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref):
+    """Windowed (4-bit) ladder on the twist — the Fp2 analogue of
+    pallas_ops._scalar_mul_kernel. p_ref: (6, 16, B) = (X0,X1,Y0,Y1,Z0,Z1)
+    Jacobian Montgomery; k_ref: (16, B) plain scalars."""
+    F2 = make_fp2(m_ref[:], np_ref[0, 0])
+    pdouble, padd, inf_like = make_g2_group(F2)
+
+    P = ((p_ref[0], p_ref[1]), (p_ref[2], p_ref[3]), (p_ref[4], p_ref[5]))
+    k = k_ref[:]
+
+    tab = [inf_like(P), P]
+    for d in range(2, 16):
+        tab.append(pdouble(tab[d // 2]) if d % 2 == 0
+                   else padd(tab[d - 1], P))
+    # (16, 6, 16, B) stacked coordinate components for per-lane select
+    comp = [jnp.stack([t[c][i] for t in tab])
+            for c in range(3) for i in range(2)]
+
+    rows = []
+    for w in range(63, -1, -1):
+        limb, s = divmod(w, 4)
+        rows.append((k[limb] >> np.uint32(4 * s)) & np.uint32(0xF))
+    dig_ref[:] = jnp.stack(rows)              # (64, B) MSB first
+
+    def select(d):
+        accs = [c[0] for c in comp]
+        for v in range(1, 16):
+            mask = (d == v)[None, :]
+            accs = [jnp.where(mask, c[v], a) for c, a in zip(comp, accs)]
+        return ((accs[0], accs[1]), (accs[2], accs[3]), (accs[4], accs[5]))
+
+    acc0 = select(dig_ref[0])
+
+    def body(w, acc):
+        acc = pdouble(pdouble(pdouble(pdouble(acc))))
+        d = dig_ref[pl.ds(w, 1), :][0]
+        return padd(acc, select(d))
+
+    acc = jax.lax.fori_loop(jnp.int32(1), jnp.int32(64), body, acc0)
+    o_ref[0], o_ref[1] = acc[0]
+    o_ref[2], o_ref[3] = acc[1]
+    o_ref[4], o_ref[5] = acc[2]
+
+
+@jax.jit
+def g2_scalar_mul_flat(p, k):
+    """k*Q batched: p (N, 3, 2, 16) Jacobian Montgomery, k (N, 16) plain
+    scalars -> (N, 3, 2, 16)."""
+    N = p.shape[0]
+    n_tiles = max((N + LANES - 1) // LANES, 1)
+    Np = n_tiles * LANES
+    pt = _pad_lanes(jnp.transpose(p.reshape(N, 6, NL), (1, 2, 0)), Np)
+    kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)
+    m_in, np_in = _mnp()
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _g2_scalar_mul_kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((NL, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((6, NL, LANES), lambda i: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((NL, LANES), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((6, NL, LANES), lambda i: (0, 0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((6, NL, Np), jnp.uint32),
+            scratch_shapes=[pltpu.VMEM((64, LANES), jnp.uint32)],
+            interpret=INTERPRET,
+        )(m_in, np_in, pt, kt)
+    return jnp.transpose(out, (2, 0, 1))[:N].reshape(N, 3, 2, NL)
+
+
+# ---------------------------------------------------------------------------
+# Full pairing: miller kernel + final exp (kernels + light jnp glue)
+# ---------------------------------------------------------------------------
+
+_U_LIMBS = None
+
+
+def _u_limbs(N):
+    global _U_LIMBS
+    if _U_LIMBS is None:
+        _U_LIMBS = np.asarray(params.to_limbs(params.U), dtype=np.uint32)
+    return jnp.broadcast_to(jnp.asarray(_U_LIMBS), (N, NL))
+
+
+def final_exp_flat(f):
+    """Reduced pairing final exponentiation, batched (N, 6, 2, 16).
+
+    Same structure as pairing.final_exp: easy part, then the DSD hard part
+    with 3 exponentiations by u (63-bit pow kernel) + Frobenius maps (jnp —
+    conjugation and 6 constant Fp2 muls are cheap) + the Olivos chain via
+    the mul kernel.
+    """
+    N = f.shape[0]
+
+    def frob(g, which: int):
+        return f12_slotmul_flat(g, f"frob{which}")
+
+    def conj(g):
+        return f12_slotmul_flat(g, "conj6")
+
+    mul = f12_mul_flat
+    u = _u_limbs(N)
+
+    f1 = mul(conj(f), f12_inv_flat(f))
+    f2 = mul(frob(f1, 2), f1)
+
+    fx = f12_pow_flat(f2, u, n_bits=params.U.bit_length())
+    fx2 = f12_pow_flat(fx, u, n_bits=params.U.bit_length())
+    fx3 = f12_pow_flat(fx2, u, n_bits=params.U.bit_length())
+
+    y0 = mul(mul(frob(f2, 1), frob(f2, 2)), frob(f2, 3))
+    y1 = conj(f2)
+    y2 = frob(fx2, 2)
+    y3 = conj(frob(fx, 1))
+    y4 = conj(mul(fx, frob(fx2, 1)))
+    y5 = conj(fx2)
+    y6 = conj(mul(fx3, frob(fx3, 1)))
+
+    sqr = lambda g: mul(g, g)
+    t0 = mul(mul(sqr(y6), y4), y5)
+    t1 = mul(mul(y3, y5), t0)
+    t0 = mul(t0, y2)
+    t1 = mul(sqr(t1), t0)
+    t1 = sqr(t1)
+    t0b = mul(t1, y1)
+    t1 = mul(t1, y0)
+    t0b = sqr(t0b)
+    return mul(t0b, t1)
+
+
+def pair_flat(px, py, qx, qy):
+    """Full reduced optimal ate pairing, batched flat inputs:
+    px, py (N, 16); qx, qy (N, 2, 16) -> (N, 6, 2, 16)."""
+    return final_exp_flat(miller_flat(px, py, qx, qy))
+
+
+__all__ = ["miller_flat", "f12_mul_flat", "f12_inv_flat", "f12_pow_flat",
+           "f12_slotmul_flat", "final_exp_flat", "pair_flat",
+           "fp_inv_flat", "f2_inv_flat", "g2_scalar_mul_flat"]

@@ -143,6 +143,7 @@ class DrynxNode:
         self._range_sigs: dict[int, rproof.RangeSig] = {}  # CN role, per u
         self._survey_ctx: dict[str, dict] = {}             # VN role
         self._proof_threads: dict[str, list] = {}          # prover roles
+        self._state_lock = threading.Lock()  # handlers run on server threads
 
         s = self.server
         s.register("set_roster", self._h_set_roster)
@@ -254,13 +255,17 @@ class DrynxNode:
         t = threading.Thread(target=work, daemon=True)
         t.start()
         # prune finished surveys' threads so long-lived DP/CN processes don't
-        # accumulate Thread objects across surveys
-        for sid in list(self._proof_threads):
-            self._proof_threads[sid] = [
-                x for x in self._proof_threads[sid] if x.is_alive()]
-            if not self._proof_threads[sid] and sid != survey_id:
-                del self._proof_threads[sid]
-        self._proof_threads.setdefault(survey_id, []).append(t)
+        # accumulate Thread objects across surveys (handlers run on server
+        # threads — guard the shared dict)
+        with self._state_lock:
+            for sid in list(self._proof_threads):
+                alive = [x for x in self._proof_threads.get(sid, [])
+                         if x.is_alive()]
+                if alive or sid == survey_id:
+                    self._proof_threads[sid] = alive
+                else:
+                    self._proof_threads.pop(sid, None)
+            self._proof_threads.setdefault(survey_id, []).append(t)
         return t
 
     def _pub_table(self, pub: tuple) -> eg.FixedBase:
@@ -277,10 +282,11 @@ class DrynxNode:
     # InitRangeProofSignature, range_proof.go:270-288 — per-server secret)
     def _h_range_sig(self, msg: dict) -> dict:
         u = int(msg["u"])
-        if u not in self._range_sigs:
-            rng = np.random.default_rng(secrets.randbits(63))
-            self._range_sigs[u] = rproof.init_range_sig(u, rng)
-        sg = self._range_sigs[u]
+        with self._state_lock:
+            if u not in self._range_sigs:
+                rng = np.random.default_rng(secrets.randbits(63))
+                self._range_sigs[u] = rproof.init_range_sig(u, rng)
+            sg = self._range_sigs[u]
         return {"pub": [int(sg.public[0]), int(sg.public[1])],
                 "A": pack_array(sg.A)}
 
@@ -562,9 +568,11 @@ class DrynxNode:
             if e.name == self.name:
                 bm, expected = self.vn.bitmap_for(survey_id), state.expected
             else:
+                # socket timeout must outlive the remote VN's blocking wait
                 r = call_entry(e, {"type": "vn_bitmap",
                                    "survey_id": survey_id,
-                                   "wait": True, "timeout": timeout})
+                                   "wait": True, "timeout": timeout},
+                               timeout=timeout + 60.0)
                 bm, expected = r["bitmap"], r["expected"]
             if len(bm) < expected:
                 raise RuntimeError(

@@ -526,14 +526,26 @@ class LocalCluster:
     def _async_proof(self, survey: Survey, ptype: str, ident: NodeIdentity,
                      build) -> None:
         """Fire-and-track: build proof bytes + deliver to VNs on a thread
-        (the reference's async goroutine pipeline)."""
+        (the reference's async goroutine pipeline).
+
+        Device work inside the threads is SERIALIZED by one lock: many
+        threads enqueueing deep chains of large programs at once has wedged
+        the tunneled TPU worker (round-1 note; reproduced in round 2 with 10
+        concurrent range-proof creations). Threads still overlap with the
+        main phase path's host work.
+        """
+        lock = getattr(self, "_proof_device_lock", None)
+        if lock is None:
+            lock = self._proof_device_lock = threading.Lock()
 
         def work():
-            data = build()
+            with lock:
+                data = build()
             req = rq.new_proof_request(
                 ptype, survey.sq.survey_id, ident.name,
                 f"{ptype}-{ident.name}", 0, data, ident.secret)
-            self.vns.deliver(req)
+            with lock:
+                self.vns.deliver(req)
 
         t = threading.Thread(target=work, daemon=True)
         t.start()

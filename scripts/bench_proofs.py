@@ -62,6 +62,16 @@ def main():
     print(f"verify: first {verify_first:.2f}s (compile), best {best_verify:.4f}s "
           f"({n_proofs / best_verify:.0f} digit-proofs/s)")
     print(f"reference VN range-verify phase: 21.73 s (TIFS timeline)")
+    import json
+
+    print(json.dumps({
+        "metric": "range_proof_throughput",
+        "create_digit_proofs_per_s": round(n_proofs / best_create, 1),
+        "verify_digit_proofs_per_s": round(n_proofs / best_verify, 1),
+        "create_seconds": round(best_create, 4),
+        "verify_seconds": round(best_verify, 4),
+        "batch": {"ns": ns, "V": V, "l": l},
+    }))
 
 
 if __name__ == "__main__":

@@ -6,8 +6,11 @@ set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
-# keep e2e on CPU so it never contends with TPU benchmarks
+# keep e2e on CPU so it never contends with TPU benchmarks; pin the fast
+# unoptimized CPU codegen (the crypto graphs otherwise compile for ages and
+# the auto-detected ISA has SIGILL'd — see tests/conftest.py)
 export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_cpu_max_isa=AVX2 --xla_backend_optimization_level=0"
 
 SERVER="python -m drynx_tpu.cmd.server"
 CLIENT="python -m drynx_tpu.cmd.client"

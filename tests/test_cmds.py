@@ -32,8 +32,11 @@ def test_shell_e2e(script):
         script.chmod(st.st_mode | stat.S_IXUSR)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # scripts pin their own platform config
+    # the proofs pipeline compiles pairing kernels in EVERY server process
+    # on CPU — give it the cold-compile budget
+    limit = 5400 if "proofs" in script.name else 900
     r = subprocess.run(["bash", str(script)], capture_output=True, text=True,
-                       timeout=900, env=env)
+                       timeout=limit, env=env)
     assert r.returncode == 0, (
         f"{script.name} failed\nstdout:\n{r.stdout}\nstderr:\n{r.stderr}")
     assert "OK" in r.stdout

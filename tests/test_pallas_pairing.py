@@ -1,0 +1,80 @@
+"""Pallas pairing kernels vs the jnp pairing + pure-Python oracle
+(interpret mode on CPU; the real-chip path is exercised by
+scripts/bench_proofs.py and the TPU benches — all kernels here were
+verified against the oracle on the actual v5e chip during development).
+
+Covers: Fp12 mul/inv/pow kernels, the ate Miller kernel (up to the free
+Fp2 line scales — compared after final exponentiation), and the full
+reduced pairing against refimpl.pair.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from drynx_tpu.crypto import fp2 as F2
+from drynx_tpu.crypto import fp12 as F12
+from drynx_tpu.crypto import field as F
+from drynx_tpu.crypto import pallas_ops as po
+from drynx_tpu.crypto import pallas_pairing as pp
+from drynx_tpu.crypto import params, refimpl
+
+# Interpreting the pairing kernels on CPU compiles for >40 min on this
+# one-core box (same reason the ladder kernels are opt-in,
+# tests/test_pallas_kernels.py:16); they are validated on hardware.
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        os.environ.get("DRYNX_PALLAS_INTERPRET_TESTS", "0") != "1",
+        reason="pairing-kernel interpret compile is ~1h on CPU; verified "
+               "on TPU by scripts/bench_proofs.py"),
+]
+
+RNG = np.random.default_rng(23)
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(po, "INTERPRET", True)
+    monkeypatch.setattr(pp, "INTERPRET", True)
+
+
+def rfp():
+    return int.from_bytes(RNG.bytes(40), "little") % params.P
+
+
+def rf12():
+    return tuple((rfp(), rfp()) for _ in range(6))
+
+
+def test_f12_mul_inv_pow_kernels():
+    a, b = rf12(), rf12()
+    da = jnp.asarray(F12.from_ref(a))[None]
+    db = jnp.asarray(F12.from_ref(b))[None]
+    assert F12.to_ref(pp.f12_mul_flat(da, db)[0]) == refimpl.fp12_mul(a, b)
+    inv = pp.f12_inv_flat(da)
+    assert refimpl.fp12_mul(F12.to_ref(inv[0]), a) == refimpl.FP12_ONE
+
+    e = 0xABCDEF123456
+    k = jnp.asarray(F.from_int(e))[None]
+    got = pp.f12_pow_flat(da, k, n_bits=48)
+    assert F12.to_ref(got[0]) == refimpl.fp12_pow(a, e)
+
+
+def test_pair_kernel_matches_oracle():
+    P1 = refimpl.g1_mul(refimpl.G1, 5)
+    Q1 = refimpl.g2_mul(refimpl.G2, 9)
+    xp = jnp.asarray(F.from_int(P1[0] * params.R % params.P))[None]
+    yp = jnp.asarray(F.from_int(P1[1] * params.R % params.P))[None]
+    xq = jnp.asarray(F2.from_ref(Q1[0]))[None]
+    yq = jnp.asarray(F2.from_ref(Q1[1]))[None]
+
+    want = refimpl.pair(P1, Q1)
+    # Miller value differs from the jnp one only by free Fp2 line scales:
+    # compare after the final exponentiation
+    gm = pp.miller_flat(xp, yp, xq, yq)
+    assert refimpl.final_exp(F12.to_ref(gm[0])) == want
+
+    got = pp.pair_flat(xp, yp, xq, yq)
+    assert F12.to_ref(got[0]) == want

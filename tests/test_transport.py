@@ -53,9 +53,11 @@ def test_remote_survey_with_proofs(tmp_path):
     roster = Roster(entries)
     client = RemoteClient(roster, rng)
     client.broadcast_roster()
+    # generous timeout: a cold CPU process compiles every proof kernel on
+    # first use (tens of minutes at opt-level 0 on one core)
     result, block = client.run_survey(
         "sum", query_min=0, query_max=9, proofs=True, ranges=[(4, 4)],
-        dlog=eg.DecryptionTable(limit=500), timeout=600.0)
+        dlog=eg.DecryptionTable(limit=500), timeout=2400.0)
     want = int(sum(d.sum() for d in datas))
     assert result == want
 
