@@ -42,6 +42,17 @@ _U_BITS_LSB = [(params.U >> i) & 1 for i in range(params.U.bit_length())]
 _PM2_BITS = [int(b) for b in bin(params.P - 2)[2:]]       # MSB-first
 
 
+def _fermat_inv(x, mul):
+    """x^(p-2) via the static square-and-multiply chain — the ONE copy all
+    inversion kernels share (Fp inversion inside Fp2/Fp6/Fp12 towers)."""
+    acc = x
+    for bit in _PM2_BITS[1:]:
+        acc = mul(acc, acc)
+        if bit:
+            acc = mul(acc, x)
+    return acc
+
+
 # ---------------------------------------------------------------------------
 # In-kernel Fp2 / Fp12 arithmetic on (16, B) limb tiles
 # ---------------------------------------------------------------------------
@@ -132,13 +143,7 @@ def make_fp12(F2):
         return (F2["mul_xi"](a[2]), a[0], a[1])
 
     def fp_inv(x):
-        """x^(p-2) — Fermat inversion, static square-and-multiply chain."""
-        acc = x
-        for bit in _PM2_BITS[1:]:
-            acc = F2["fp_mul"](acc, acc)
-            if bit:
-                acc = F2["fp_mul"](acc, x)
-        return acc
+        return _fermat_inv(x, F2["fp_mul"])
 
     def f2inv(a):
         n = F2["fp_add"](F2["fp_mul"](a[0], a[0]), F2["fp_mul"](a[1], a[1]))
@@ -278,7 +283,11 @@ def _miller_kernel(m_ref, np_ref, g_ref, bits_ref, p_ref, q_ref, o_ref):
         """Mixed add T + (qx, qy) with the line through them; the whole line
         may be scaled by any Fp2 factor (killed by the final exponentiation),
         so the madd-convention sign flip is free (pairing.py's line times -1:
-        l0 = Hm Z yp, l1 = -r1 xp, l3 = r1 xq - Hm Z yq)."""
+        l0 = Hm Z yp, l1 = -r1 xp, l3 = r1 xq - Hm Z yq).
+
+        Vertical degeneracy (Hm = 0: x_T == x_Q, possible only on crafted
+        wire points) mirrors the jnp miller_loop: line contributes 1 and the
+        point update is skipped — TPU and CPU verifiers must agree."""
         X1, Y1, Z1 = T
         zz = F2["sqr"](Z1)
         U2 = F2["mul"](qx, zz)
@@ -300,7 +309,12 @@ def _miller_kernel(m_ref, np_ref, g_ref, bits_ref, p_ref, q_ref, o_ref):
         YJ = F2["mul"](Y1, J)
         Y3 = F2["sub"](F2["mul"](rm, F2["sub"](V, X3)), F2["add"](YJ, YJ))
         Z3 = F2["sub"](F2["sub"](F2["sqr"](F2["add"](Z1, Hm)), zz), HH)
-        return (X3, Y3, Z3), f2
+        degen = _f2_is_zero(Hm)
+        Tn = tuple((jnp.where(degen[None, :], a[0], b[0]),
+                    jnp.where(degen[None, :], a[1], b[1]))
+                   for a, b in zip(T, (X3, Y3, Z3)))
+        fn = _f12_select(degen, f, f2)
+        return Tn, fn
 
     T0 = (xq, yq, (one_m, jnp.zeros((NL, B), jnp.uint32)))
     f0 = _f12_one_tiles(g_ref[:, 6:7], B)
@@ -622,13 +636,7 @@ def f12_pow_flat(f, k, n_bits: int = 256):
 
 def _fp_inv_kernel(m_ref, np_ref, x_ref, o_ref):
     F2 = make_fp2(m_ref[:], np_ref[0, 0])
-    x = x_ref[:]
-    acc = x
-    for bit in _PM2_BITS[1:]:
-        acc = F2["fp_mul"](acc, acc)
-        if bit:
-            acc = F2["fp_mul"](acc, x)
-    o_ref[:] = acc
+    o_ref[:] = _fermat_inv(x_ref[:], F2["fp_mul"])
 
 
 @jax.jit
@@ -664,11 +672,7 @@ def _f2_inv_kernel(m_ref, np_ref, a_ref, o_ref):
     a = (a_ref[0], a_ref[1])
     # norm = a0^2 + a1^2; inv via Fermat; out = (a0*ni, -a1*ni)
     n = F2["fp_add"](F2["fp_mul"](a[0], a[0]), F2["fp_mul"](a[1], a[1]))
-    acc = n
-    for bit in _PM2_BITS[1:]:
-        acc = F2["fp_mul"](acc, acc)
-        if bit:
-            acc = F2["fp_mul"](acc, n)
+    acc = _fermat_inv(n, F2["fp_mul"])
     z = jnp.zeros_like(a[1])
     o_ref[0] = F2["fp_mul"](a[0], acc)
     o_ref[1] = F2["fp_mul"](F2["fp_sub"](z, a[1]), acc)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,10 +50,48 @@ class RangeSig:
     secret: int
     public: tuple           # host affine G1 ints (y = x·B)
     A: np.ndarray           # (u, 3, 2, 16) G2 Jacobian Montgomery limbs
+    gt: Optional[np.ndarray] = None   # (u, 6, 2, 16) e(B, A[k]) cache
 
     @property
     def u(self) -> int:
         return self.A.shape[0]
+
+
+def sig_gt_table(sigs: list["RangeSig"]) -> jnp.ndarray:
+    """(ns, u, 6, 2, 16): gtA[i][k] = e(B, A_i[k]), computed once per
+    signature set (u*ns pairings) and cached on the RangeSig objects.
+
+    This is the prover-side shortcut the fixed digit-signature structure
+    allows: a_ij = e(-s_j B, v_ij A_i[phi_j]) * gtB^t = gtA[i][phi_j]^(-s_j
+    v_ij) * gtB^t — one GT exponentiation instead of a Miller loop + final
+    exp per digit (the reference pairs every element,
+    range_proof.go:396-404)."""
+    from ..crypto import batching as B
+
+    # module-level cache keyed by the A-table bytes: the TCP path rebuilds
+    # RangeSig objects from the wire for every survey, so instance-level
+    # caching alone would recompute the "one-time" table each survey
+    for sg in sigs:
+        if sg.gt is None:
+            sg.gt = _GT_TABLE_CACHE.get(sg.A.tobytes())
+
+    missing = [sg for sg in sigs if sg.gt is None]
+    if missing:
+        A_all = jnp.asarray(np.stack([sg.A for sg in missing]))
+        ns, u = A_all.shape[0], A_all.shape[1]
+        qx, qy, _ = B.g2_normalize(A_all)
+        bx = jnp.asarray(F.to_mont(jnp.asarray(
+            F.from_int(params.G1_GEN[0])), FP))
+        by = jnp.asarray(F.to_mont(jnp.asarray(
+            F.from_int(params.G1_GEN[1])), FP))
+        gt = np.asarray(B.pair(bx, by, qx, qy))
+        for i, sg in enumerate(missing):
+            sg.gt = gt[i]
+            _GT_TABLE_CACHE[sg.A.tobytes()] = gt[i]
+    return jnp.asarray(np.stack([sg.gt for sg in sigs]))
+
+
+_GT_TABLE_CACHE: dict = {}
 
 
 def init_range_sig(u: int, rng: np.random.Generator) -> RangeSig:
@@ -245,7 +284,8 @@ def sum_publics_bytes(sigs: list[RangeSig]) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int):
+def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
+                   gtA=None):
     """Device part of proof creation, built from bucketed primitives (each
     compiles once per size bucket — see crypto/batching.py).
 
@@ -284,13 +324,20 @@ def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int):
     V_pts = B.g2_scalar_mul(A_sel, v)
     sync(V_pts)
 
-    # a_ij = e(−s_j·B, V_ij) · gtB^{t_j}
-    neg_s = B.fn_neg(s)
-    nsB = B.fixed_base_mul(base_tbl, neg_s)                # (V, l, 3, 16)
-    px, py, _ = B.g1_normalize(nsB)
-    qx, qy, _ = B.g2_normalize(V_pts)
-    sync(qx)
-    gt1 = B.pair(px, py, qx, qy)                           # (ns, V, l, 6, 2, 16)
+    # a_ij = e(−s_j·B, V_ij) · gtB^{t_j}. With the per-signature GT table
+    # (sig_gt_table) the pairing collapses to gtA[i][φ_j]^(−s_j·v_ij):
+    # e(−sB, vA[φ]) = e(B, A[φ])^(−sv) by bilinearity.
+    if gtA is not None:
+        gt_sel = gtA[:, digits]                            # (ns, V, l, 6,2,16)
+        sv = B.fn_mul_plain(s, v)                          # (ns, V, l, 16)
+        gt1 = B.gt_pow(gt_sel, B.fn_neg(sv))
+    else:
+        neg_s = B.fn_neg(s)
+        nsB = B.fixed_base_mul(base_tbl, neg_s)            # (V, l, 3, 16)
+        px, py, _ = B.g1_normalize(nsB)
+        qx, qy, _ = B.g2_normalize(V_pts)
+        sync(qx)
+        gt1 = B.pair(px, py, qx, qy)                       # (ns, V, l, 6,2,16)
     sync(gt1)
     gt2 = B.gt_pow(gt_base(), t)                           # (V, l, 6, 2, 16)
     a = B.gt_mul(gt1, gt2)
@@ -302,13 +349,18 @@ def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int):
 
 
 def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
-                        u: int, l: int, ca_pub_table) -> RangeProofBatch:
+                        u: int, l: int, ca_pub_table,
+                        use_gt_table: bool = True) -> RangeProofBatch:
     """Create proofs for V values at once.
 
     secrets: int64 (V,) plaintexts; rs: (V, 16) encryption blinding scalars;
     cts: (V, 2, 3, 16) their ciphertexts under the collective key;
     ca_pub_table: fixed-base table of the collective key P.
     (Reference CreatePredicateRangeProofForAllServ, range_proof.go:320-407.)
+
+    use_gt_table: compute a_ij via the cached e(B, A[k]) table (one GT
+    exponentiation per digit) instead of a pairing per digit — u*ns one-time
+    pairings amortized over every proof against these signatures.
     """
     V = int(np.asarray(secrets).shape[0])
     ns = len(sigs)
@@ -321,10 +373,11 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     m = eg.random_scalars(ks[2], (V, l))
     v = eg.random_scalars(ks[3], (ns, V, l))
     A_tab = jnp.asarray(np.stack([sg.A for sg in sigs]))   # (ns, u, 3, 2, 16)
+    gtA = sig_gt_table(sigs) if use_gt_table else None
 
     D, zphi, zr, V_pts, a, zv = _create_kernel(
         jnp.asarray(digits), c, jnp.asarray(rs), s, t, m, v, A_tab,
-        ca_pub_table, u, l)
+        ca_pub_table, u, l, gtA=gtA)
     return RangeProofBatch(commit=jnp.asarray(cts), challenge=c, zr=zr, d=D,
                            zphi=zphi, zv=zv, v_pts=V_pts, a=a, u=u, l=l)
 
@@ -493,7 +546,8 @@ def verify_range_proof_list(lst: RangeProofList, ranges,
     return True
 
 
-__all__ = ["RangeSig", "init_range_sig", "to_base", "RangeProofBatch",
+__all__ = ["RangeSig", "init_range_sig", "sig_gt_table", "to_base",
+           "RangeProofBatch",
            "RangeProofList", "group_ranges", "create_range_proofs",
            "create_range_proof_list", "verify_range_proofs",
            "verify_range_proof_list", "challenge_for_commits", "gt_base",

@@ -493,7 +493,9 @@ class DrynxNode:
         switched = jnp.stack([k_sum, B.g1_add(agg[:, 1], c_sum)], axis=-3)
         # let this node's own proof threads drain before replying so the
         # querier's end_verification doesn't race local stragglers
-        for t in self._proof_threads.pop(survey_id, []):
+        with self._state_lock:
+            drained = self._proof_threads.pop(survey_id, [])
+        for t in drained:
             t.join(timeout=300)
         return {"switched": pack_array(np.asarray(switched))}
 
@@ -686,10 +688,12 @@ class RemoteClient:
         if not proofs:
             return result
 
+        # the handler may block ~timeout on its own counter AND ~timeout per
+        # straggling VN; budget the socket for both phases
         block = call_entry(vns[0], {"type": "end_verification",
                                     "survey_id": survey_id,
                                     "timeout": timeout},
-                           timeout=timeout + 60.0)
+                           timeout=2 * timeout + 120.0)
         return result, block
 
 

@@ -149,6 +149,8 @@ class LocalCluster:
 
         self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
         self.surveys: dict[str, Survey] = {}
+        # serializes proof threads' device work (see _async_proof)
+        self._proof_device_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # Proof payload verifiers installed at the VNs
@@ -534,9 +536,7 @@ class LocalCluster:
         concurrent range-proof creations). Threads still overlap with the
         main phase path's host work.
         """
-        lock = getattr(self, "_proof_device_lock", None)
-        if lock is None:
-            lock = self._proof_device_lock = threading.Lock()
+        lock = self._proof_device_lock
 
         def work():
             with lock:
