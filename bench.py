@@ -14,10 +14,16 @@ weak #2 — this is the honest version). The exec-only number vs its own 1.32 s
 baseline is printed to stderr alongside the phase breakdown.
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from drynx_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
 
 BASELINE_PROOFS_S = 12.2
 BASELINE_EXEC_S = 1.32

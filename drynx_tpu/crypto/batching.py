@@ -31,10 +31,13 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8):
     the smallest bucket — raise it for compile-heavy kernels (pairings) so a
     single compile serves every small batch.
 
-    NOTE: the on-disk persistent compilation cache is deliberately NOT used
-    for these kernels — jaxlib has been observed to segfault deserializing
-    the very large serialized executables (crash in
-    compilation_cache.get_executable_and_time); see conftest/__init__.
+    NOTE on the persistent compilation cache: the CPU test suite keeps it
+    OFF (jaxlib segfaulted deserializing very large CPU-backend executables
+    — crash in compilation_cache.get_executable_and_time; see
+    tests/conftest.py). The TPU bench/entry paths DO enable it
+    (drynx_tpu/utils/cache.py) — TPU executables round-trip fine and the
+    cache cuts the ~60-90 min cold-process Mosaic compile bill to
+    lowering time only.
 
     tail_ranks: pytree matching fn's positional args, each leaf an int = the
     rank of that argument's per-element (non-batch) suffix, or -1 to pass the
@@ -131,7 +134,8 @@ def _build():
 
     def _gt_pow_fn(f, k):
         if po.available():
-            return ppair.f12_pow_flat(f, k)
+            # windowed kernel: ~2.4x over the square-and-multiply ladder
+            return ppair.f12_wpow_flat(f, k)
         return F12.pow_var(f, k)
 
     def _gt_mul_fn(a, b):
@@ -139,8 +143,29 @@ def _build():
             return ppair.f12_mul_flat(a, b)
         return F12.mul(a, b)
 
+    def _miller_fn(px, py, qx, qy):
+        if po.available():
+            return ppair.miller_flat(px, py, qx, qy)
+        return PAIR.miller_loop((px, py), (qx, qy))
+
+    def _gt_pow64_fn(f, k):
+        # short exponents (RLC verification weights < 2^62): 21 windows;
+        # n_bits=63 deliberately matches the final-exp u-chain pows so a
+        # shared (n_bits, wbits) jit entry can be reused at equal shapes
+        if po.available():
+            return ppair.f12_wpow_flat(f, k, n_bits=63)
+        return F12.pow_var(f, k)
+
+    def _final_exp_fn(f):
+        if po.available():
+            return ppair.final_exp_flat(f)
+        return PAIR.final_exp(f)
+
     g["pair"] = bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32)
+    g["miller"] = bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32)
     g["gt_pow"] = bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32)
+    g["gt_pow64"] = bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32)
+    g["final_exp"] = bucketed(_final_exp_fn, (3,), 3, min_bucket=8)
     g["gt_mul"] = bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32)
     g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32)
     g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1)
@@ -160,12 +185,39 @@ def _build():
     g["is_infinity"] = bucketed(C.is_infinity, (2,), 0)
 
 
+def gt_reduce_prod(x):
+    """Product of N GT elements: (N, 6, 2, 16) -> (6, 2, 16).
+
+    TPU path pads with Montgomery ones to the next power of 8 and applies
+    the 8-way product kernel log8(N) times (4 dispatches for N <= 4096);
+    fallback is a log2 tree of gt_mul."""
+    from . import fp12 as F12
+    from . import pallas_ops as po
+    from . import pallas_pairing as ppair
+
+    x = jnp.asarray(x)
+    N = int(x.shape[0])
+    if N == 1:
+        return x[0]
+    if not po.available():
+        return tree_reduce_add(x, gt_mul, axis=0)
+    target = 8
+    while target < N:
+        target *= 8
+    if target != N:
+        x = jnp.concatenate([x, F12.one((target - N,))], axis=0)
+    while x.shape[0] > 1:
+        x = ppair.f12_mulreduce8_flat(x.reshape(-1, 8, 6, 2, 16))
+    return x[0]
+
+
 _build()
 
-__all__ = ["bucketed", "tree_reduce_add", "g1_add", "g1_neg",
-           "g1_scalar_mul", "g1_eq",
+__all__ = ["bucketed", "tree_reduce_add", "gt_reduce_prod", "g1_add",
+           "g1_neg", "g1_scalar_mul", "g1_eq",
            "g1_normalize", "g2_scalar_mul", "g2_normalize", "fixed_base_mul",
-           "pair", "gt_pow", "gt_mul", "gt_eq", "fn_add", "fn_sub", "fn_neg",
+           "pair", "miller", "gt_pow", "gt_pow64", "final_exp", "gt_mul",
+           "gt_eq", "fn_add", "fn_sub", "fn_neg",
            "fn_mul_plain", "fn_mont_mul", "encrypt", "int_to_scalar",
            "table_lookup", "ct_add", "ct_scalar_mul", "decrypt_point",
            "is_infinity"]

@@ -1,0 +1,37 @@
+"""Persistent XLA compilation cache for the TPU bench/entry paths.
+
+The Mosaic crypto kernels compile for minutes each (the full proof pipeline
+is ~60-90 min of remote AOT compiles on a cold process). The persistent
+cache cuts a warm process to tracing+lowering time only (~seconds for small
+kernels, ~1-3 min for the big pow/ladder kernels — lowering happens before
+the cache lookup and cannot be cached).
+
+Notes:
+- Must be enabled via jax.config.update (the environment variable is
+  snapshotted before user code runs: sitecustomize imports jax first).
+- Keys are stable across processes for identical call sites (verified:
+  byte-identical lowered modules + observed cross-process hits).
+- Deliberately NOT enabled for the CPU test suite: jaxlib has segfaulted
+  deserializing very large CPU-backend executables (tests/conftest.py).
+"""
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point jax's persistent compilation cache at a repo-local directory.
+
+    Safe to call multiple times. Returns the cache dir in use.
+    """
+    import jax
+
+    if cache_dir is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        cache_dir = os.path.join(root, ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    return cache_dir

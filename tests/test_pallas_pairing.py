@@ -78,3 +78,30 @@ def test_pair_kernel_matches_oracle():
 
     got = pp.pair_flat(xp, yp, xq, yq)
     assert F12.to_ref(got[0]) == want
+
+
+def test_wpow_kernel_matches_oracle():
+    a = rf12()
+    da = jnp.asarray(F12.from_ref(a))[None]
+    e = 0xBEEF1234
+    k = jnp.asarray(F.from_int(e))[None]
+    got = pp.f12_wpow_flat(da, k, n_bits=32)
+    assert F12.to_ref(got[0]) == refimpl.fp12_pow(a, e)
+
+
+def test_mulreduce8_and_fixed_base_pow():
+    vals = [rf12() for _ in range(8)]
+    g = jnp.asarray(np.stack([F12.from_ref(v) for v in vals]))[None]
+    got = pp.f12_mulreduce8_flat(g)
+    want = vals[0]
+    for v in vals[1:]:
+        want = refimpl.fp12_mul(want, v)
+    assert F12.to_ref(got[0]) == want
+
+    from drynx_tpu.proofs import range_proof as rp
+    tab = rp.gt_base_table()
+    gtb = refimpl.pair(refimpl.G1, refimpl.G2)
+    e = int.from_bytes(RNG.bytes(20), "little")
+    k = jnp.asarray(F.from_int(e))[None]
+    got = pp.gt_pow_fixed(tab, k)
+    assert F12.to_ref(got[0]) == refimpl.fp12_pow(gtb, e)

@@ -97,12 +97,12 @@ def test_proof_request_bitmap_codes(keys):
                                b"payload-bytes", x)
     rng = np.random.default_rng(0)
     # good signature + always-sampled + passing payload -> BM_TRUE
-    assert rq.verify_proof_request(req, pub, 1.0, lambda d: True, rng) == rq.BM_TRUE
+    assert rq.verify_proof_request(req, pub, 1.0, lambda d, sv: True, rng) == rq.BM_TRUE
     # failing payload -> BM_FALSE
-    assert rq.verify_proof_request(req, pub, 1.0, lambda d: False, rng) == rq.BM_FALSE
+    assert rq.verify_proof_request(req, pub, 1.0, lambda d, sv: False, rng) == rq.BM_FALSE
     # sampling off -> BM_RECVD
-    assert rq.verify_proof_request(req, pub, 0.0, lambda d: True, rng) == rq.BM_RECVD
+    assert rq.verify_proof_request(req, pub, 0.0, lambda d, sv: True, rng) == rq.BM_RECVD
     # wrong sender key -> BM_BADSIG
     other = eg.keygen(np.random.default_rng(99))[1]
-    assert rq.verify_proof_request(req, other, 1.0, lambda d: True, rng) == rq.BM_BADSIG
+    assert rq.verify_proof_request(req, other, 1.0, lambda d, sv: True, rng) == rq.BM_BADSIG
     assert req.storage_key() == "sv1/aggregation/dp0/g0"

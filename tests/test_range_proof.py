@@ -107,3 +107,30 @@ def test_range_proof_serialization_roundtrip(setup):
     assert back.u == U and back.l == L
     ok = rp.verify_range_proofs(back, [s.public for s in sigs], ca_tbl.table)
     assert bool(np.all(ok))
+
+
+def test_range_proof_rlc_batch_verify(setup):
+    """RLC single-verdict path: accepts good batches, rejects tampering."""
+    sigs, _, _, ca_tbl = setup
+    pubs = [s.public for s in sigs]
+    values = np.asarray([5, 63, 0], dtype=np.int64)
+    key = jax.random.PRNGKey(9)
+    cts, rs = eg.encrypt_ints(key, ca_tbl, values)
+    proof = rp.create_range_proofs(
+        jax.random.PRNGKey(6), values, rs, cts, sigs, U, L, ca_tbl.table)
+    rng = np.random.default_rng(1)
+    assert rp.verify_range_proofs_batch(proof, pubs, ca_tbl.table, rng=rng)
+    # tampered a (one GT element replaced) -> reject
+    bad_a = np.asarray(proof.a).copy()
+    bad_a[0, 1] = np.asarray(F12.from_ref(refimpl.pair(refimpl.G1,
+                                                       refimpl.G2)))
+    import dataclasses as dc
+    bad = dc.replace(proof, a=jnp.asarray(bad_a))
+    assert not rp.verify_range_proofs_batch(bad, pubs, ca_tbl.table,
+                                            rng=np.random.default_rng(2))
+    # tampered zv -> reject
+    bad_zv = np.asarray(proof.zv).copy()
+    bad_zv[0, 0, 0, 0] ^= 1
+    bad2 = dc.replace(proof, zv=jnp.asarray(bad_zv))
+    assert not rp.verify_range_proofs_batch(bad2, pubs, ca_tbl.table,
+                                            rng=np.random.default_rng(3))
