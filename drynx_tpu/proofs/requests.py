@@ -94,7 +94,15 @@ def verify_proof_request(req: ProofRequest, sender_pub,
     except Exception:
         # a malformed/malicious payload is a FAILED verification, not a
         # crash: the proof must still be counted so the survey's expected-
-        # proof counter drains and the (dirty) audit block can commit
+        # proof counter drains and the (dirty) audit block can commit.
+        # Log it — an honest deployment hitting a verifier bug would
+        # otherwise be indistinguishable from a malicious prover.
+        import traceback
+
+        from ..utils import log
+
+        log.warn(f"verify_payload raised for {req.storage_key()}: "
+                 f"{traceback.format_exc(limit=8)}")
         ok = False
     return BM_TRUE if ok else BM_FALSE
 
