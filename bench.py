@@ -8,17 +8,25 @@ Baselines (BASELINE.md, reference TIFS/logRegV2.py:9-14, Go/CPU):
   proofs ON  total: 12.2 s   (exec 1.2 + proof overhead 10.9 + decode 0.12)
   exec-only  total: ~1.32 s  (exec + decode, no proofs)
 
-The headline JSON line reports the PROOFS-ON time against the proofs-on
-baseline (round-1 compared a proofs-off run against 12.2 s; see VERDICT.md
-weak #2 — this is the honest version). The exec-only number vs its own 1.32 s
-baseline is printed to stderr alongside the phase breakdown.
+Structure (round-3 VERDICT #1): the PROOFS-ON benchmark runs FIRST and the
+headline JSON prints immediately after the first successful timed run, so a
+driver-budget timeout cannot erase the result. Extra timed runs and the
+exec-only number are bonus stderr diagnostics after the JSON is out. Exactly
+ONE JSON line is printed to stdout either way.
 """
+import faulthandler
 import json
 import os
+import signal
 import sys
 import time
 
 import numpy as np
+
+# live stack dumps on demand (kill -USR1 <pid>) and periodic stall traces:
+# round-3 debugging found the process wedged at 0% CPU with no evidence
+faulthandler.register(signal.SIGUSR1, file=sys.stderr)
+faulthandler.dump_traceback_later(900, repeat=True, file=sys.stderr)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from drynx_tpu.utils.cache import enable_compilation_cache
@@ -29,9 +37,11 @@ BASELINE_PROOFS_S = 12.2
 BASELINE_EXEC_S = 1.32
 RANGES = (16, 5)     # reference simulation preset 18 (drynx_simul.go case 18)
 
+_t0 = time.time()
+
 
 def log(msg):
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[{time.time() - _t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
 def bench_exec():
@@ -67,12 +77,9 @@ def bench_exec():
     return best
 
 
-def bench_proofs_on():
-    """Full survey through the service layer with proofs=1, threshold 1.0
-    (every VN verifies every proof) and a committed audit block."""
+def _proofs_on_cluster():
     from drynx_tpu import flagship
     from drynx_tpu.models import logreg as lr
-    from drynx_tpu.proofs import requests as rq
     from drynx_tpu.service.service import LocalCluster
 
     num_dps = 10
@@ -91,6 +98,18 @@ def bench_proofs_on():
     sq = cluster.generate_survey_query(
         "log_reg", proofs=1, lr_params=params,
         ranges=[RANGES] * V, thresholds=1.0)
+    return cluster, sq, clear_sum
+
+
+def main():
+    """Proofs-on first; print the headline JSON after the FIRST timed run."""
+    from drynx_tpu.proofs import requests as rq
+    from drynx_tpu.utils.timers import PhaseTimers
+
+    PhaseTimers.echo = True  # stream phase completions to stderr live
+
+    log("building proofs-on cluster (3 CN / 10 DP / 3 VN, thresholds=1.0)")
+    cluster, sq, clear_sum = _proofs_on_cluster()
 
     def run():
         t0 = time.perf_counter()
@@ -103,50 +122,60 @@ def bench_proofs_on():
         assert np.all(np.isfinite(res.result))
         return dt, res
 
-    dt, res = run()   # warmup / compile
-    log(f"proofs-on warmup (compile) {dt:.1f}s; phase timers: " + ", ".join(
-        f"{k}={v:.3f}s" for k, v in res.timers.items()))
-    best = float("inf")
-    for _ in range(2):
-        dt, res = run()
-        best = min(best, dt)
-    log("proofs-on phase timers (timed run): " + ", ".join(
-        f"{k}={v:.3f}s" for k, v in res.timers.items()))
-    return best
-
-
-def main():
-    exec_best = bench_exec()
-    log(f"exec-only best {exec_best:.4f}s  "
-        f"(vs {BASELINE_EXEC_S}s exec baseline: "
-        f"{BASELINE_EXEC_S / exec_best:.1f}x)")
+    def timers(res):
+        return ", ".join(f"{k}={v:.3f}s" for k, v in res.timers.items())
 
     try:
-        proofs_best = bench_proofs_on()
+        log("proofs-on warmup (compile) run starting")
+        dt, res = run()
+        log(f"proofs-on warmup done in {dt:.1f}s; timers: {timers(res)}")
+        dt, res = run()
+        log(f"proofs-on timed run 1: {dt:.4f}s; timers: {timers(res)}")
     except Exception as e:  # keep the bench record honest but non-empty
         import traceback
 
-        log("proofs-on bench FAILED: " + traceback.format_exc(limit=6))
+        log("proofs-on bench FAILED: " + traceback.format_exc(limit=8))
         log(f"falling back to the exec-only metric (proofs-on error: {e!r})")
-        print(json.dumps({
-            "metric": "encrypted_logreg_pima_10dp_EXEC_ONLY_seconds"
-                      "_proofs_on_run_failed",
-            "value": round(exec_best, 4),
-            "unit": "s",
-            "vs_baseline": round(BASELINE_EXEC_S / exec_best, 2),
-        }))
+        try:
+            exec_best = bench_exec()
+            log(f"exec-only best {exec_best:.4f}s")
+            print(json.dumps({
+                "metric": "encrypted_logreg_pima_10dp_EXEC_ONLY_seconds"
+                          "_proofs_on_run_failed",
+                "value": round(exec_best, 4),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_EXEC_S / exec_best, 2),
+            }))
+        except Exception as e2:  # the ONE-JSON-line contract must survive
+            log("exec-only fallback ALSO failed: "
+                + traceback.format_exc(limit=8))
+            print(json.dumps({
+                "metric": "bench_failed_both_paths",
+                "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+                "error": f"{e!r}; fallback: {e2!r}"[:400],
+            }))
         return
 
-    log(f"proofs-on best {proofs_best:.4f}s  "
-        f"(vs {BASELINE_PROOFS_S}s proofs-on baseline: "
-        f"{BASELINE_PROOFS_S / proofs_best:.1f}x)")
-
+    # The deliverable: print NOW, before any bonus measurement can time out.
     print(json.dumps({
         "metric": "encrypted_logreg_pima_10dp_proofs_on_total_seconds",
-        "value": round(proofs_best, 4),
+        "value": round(dt, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_PROOFS_S / proofs_best, 2),
-    }))
+        "vs_baseline": round(BASELINE_PROOFS_S / dt, 2),
+    }), flush=True)
+    log(f"headline recorded: proofs-on {dt:.4f}s = "
+        f"{BASELINE_PROOFS_S / dt:.1f}x vs the 12.2s proofs-on baseline")
+
+    # Bonus diagnostics (stderr only, best-effort).
+    try:
+        dt2, res = run()
+        log(f"proofs-on timed run 2: {dt2:.4f}s; timers: {timers(res)}")
+        exec_best = bench_exec()
+        log(f"exec-only best {exec_best:.4f}s  "
+            f"(vs {BASELINE_EXEC_S}s exec baseline: "
+            f"{BASELINE_EXEC_S / exec_best:.1f}x)")
+    except Exception as e:
+        log(f"bonus diagnostics failed (headline already out): {e!r}")
 
 
 if __name__ == "__main__":

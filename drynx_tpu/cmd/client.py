@@ -68,7 +68,31 @@ def cmd_survey_new(args) -> int:
     cfg["survey"] = {"operation": args.operation, "query_min": args.min,
                      "query_max": args.max, "proofs": bool(args.proofs),
                      "obfuscation": bool(args.obfuscation)}
+    if args.operation == "log_reg":
+        cfg["survey"]["lr"] = {
+            "features": args.lr_features, "records": args.lr_records,
+            "k": args.lr_k, "precision": args.lr_precision,
+            "iterations": args.lr_iterations, "step": args.lr_step,
+            "lambda": args.lr_lambda}
     return _emit(cfg)
+
+
+def _lr_params_of(sv: dict):
+    from ..models.logreg import LRParams
+
+    lr_cfg = sv.get("lr", {})
+    if not lr_cfg.get("features") or not lr_cfg.get("records"):
+        raise SystemExit(
+            "log_reg survey config is missing its lr section — re-run "
+            "`survey new --operation log_reg --lr-features D --lr-records N`")
+    return LRParams(
+        k=int(lr_cfg.get("k", 2)),
+        precision=float(lr_cfg.get("precision", 1e2)),
+        lambda_=float(lr_cfg.get("lambda", 1.0)),
+        step=float(lr_cfg.get("step", 0.1)),
+        max_iterations=int(lr_cfg.get("iterations", 25)),
+        n_features=int(lr_cfg["features"]),
+        n_records=int(lr_cfg["records"]))
 
 
 def cmd_survey_set_operation(args) -> int:
@@ -121,10 +145,17 @@ def cmd_survey_run(args) -> int:
     roster = Roster(entries)
     client = RemoteClient(roster)
     client.broadcast_roster()
+    lr_params = _lr_params_of(sv) if op == "log_reg" else None
+    ranges = None
+    if op == "log_reg" and sv.get("proofs"):
+        # uniform spec; the signed-offset shift (u^l/2) keeps negative
+        # fixed-point coefficients inside the proved range
+        ranges = [(16, 5)] * lr_params.num_coeffs()
     if sv.get("proofs"):
         result, block = client.run_survey(
             op, query_min=qmin, query_max=qmax, proofs=True,
             obfuscation=bool(sv.get("obfuscation", False)),
+            lr_params=lr_params, ranges=ranges,
             timeout=float(sv.get("proof_timeout", 4800.0)))
         bitmap = block.get("bitmap", {})
         print(json.dumps({"operation": op, "result": _jsonable(result),
@@ -132,7 +163,8 @@ def cmd_survey_run(args) -> int:
                           "bitmap_ok": bool(bitmap) and
                           all(v == 1 for v in bitmap.values())}))
         return 0
-    result = client.run_survey(op, query_min=qmin, query_max=qmax)
+    result = client.run_survey(op, query_min=qmin, query_max=qmax,
+                               lr_params=lr_params)
     print(json.dumps({"operation": op, "result": _jsonable(result)}))
     return 0
 
@@ -175,6 +207,15 @@ def main(argv=None) -> int:
     s_new.add_argument("--max", type=int, default=0)
     s_new.add_argument("--proofs", action="store_true")
     s_new.add_argument("--obfuscation", action="store_true")
+    s_new.add_argument("--lr-features", type=int, default=0,
+                       help="log_reg: number of features d")
+    s_new.add_argument("--lr-records", type=int, default=0,
+                       help="log_reg: TOTAL records across all DPs (N)")
+    s_new.add_argument("--lr-k", type=int, default=2)
+    s_new.add_argument("--lr-precision", type=float, default=1e2)
+    s_new.add_argument("--lr-iterations", type=int, default=25)
+    s_new.add_argument("--lr-step", type=float, default=0.1)
+    s_new.add_argument("--lr-lambda", type=float, default=1.0)
     s_new.set_defaults(fn=cmd_survey_new)
     s_op = srv.add_parser("set-operation")
     s_op.add_argument("--operation", required=True)

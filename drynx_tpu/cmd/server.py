@@ -41,7 +41,13 @@ def cmd_run(args) -> int:
 
     cfg = toml_io.loads(sys.stdin.read())["node"]
     data = None
-    if args.data:
+    if args.lr_data:
+        # (X, y) DP data for log_reg surveys: CSV, label in column 0
+        # (reference LoadData, lib/encoding/logistic_regression.go:1275)
+        from ..models import logreg as lr
+
+        data = lr.load_csv(args.lr_data)
+    elif args.data:
         data = np.loadtxt(args.data, dtype=np.int64, ndmin=1)
     node = DrynxNode(cfg["name"], int(cfg["secret"], 16),
                      (int(cfg["public_x"], 16), int(cfg["public_y"], 16)),
@@ -67,6 +73,9 @@ def main(argv=None) -> int:
     r = sub.add_parser("run", help="run node from config TOML on stdin")
     r.add_argument("--data", default=None,
                    help="path to this DP's local data (one int per line)")
+    r.add_argument("--lr-data", default=None,
+                   help="path to this DP's (X, y) CSV for log_reg surveys "
+                        "(label in column 0)")
     r.add_argument("--db", default=None,
                    help="proof/skipchain DB path (VN role)")
     r.set_defaults(fn=cmd_run)

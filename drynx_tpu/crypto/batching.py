@@ -23,13 +23,17 @@ def _next_bucket(b: int, min_bucket: int = 8) -> int:
     return p
 
 
-def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8):
+def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
+             max_bucket: int | None = None):
     """Wrap fn so all leading batch dims are flattened + bucket-padded.
 
     The wrapped fn is jitted as ONE executable per bucket size, so repeated
     calls (any batch shape) reuse the in-process jit cache. min_bucket sets
     the smallest bucket — raise it for compile-heavy kernels (pairings) so a
-    single compile serves every small batch.
+    single compile serves every small batch. max_bucket CAPS the bucket:
+    larger batches run as sequential max_bucket-sized chunks, so one
+    compiled executable serves arbitrarily large batches (the whole-survey
+    joint proof paths would otherwise mint fresh 16k-element compiles).
 
     NOTE on the persistent compilation cache: the CPU test suite keeps it
     OFF (jaxlib segfaulted deserializing very large CPU-backend executables
@@ -69,10 +73,22 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8):
                 pad = jnp.broadcast_to(lb[:1], (Bp - B,) + tail)
                 lb = jnp.concatenate([lb, pad], axis=0)
             flat.append(lb)
-        out = fn(*treedef.unflatten(flat))
 
-        out_leaves, out_def = jax.tree.flatten(out)
         out_ranks = jax.tree.flatten(out_tail_ranks)[0]
+        if max_bucket is not None and Bp > max_bucket:
+            chunks = []
+            for s in range(0, Bp, max_bucket):
+                part = [l if r < 0 else l[s:s + max_bucket]
+                        for l, r in zip(flat, ranks)]
+                chunks.append(fn(*treedef.unflatten(part)))
+            chunk_leaves = [jax.tree.flatten(c)[0] for c in chunks]
+            out_def = jax.tree.flatten(chunks[0])[1]
+            out_leaves = [jnp.concatenate([c[i] for c in chunk_leaves], 0)
+                          for i in range(len(chunk_leaves[0]))]
+        else:
+            out = fn(*treedef.unflatten(flat))
+            out_leaves, out_def = jax.tree.flatten(out)
+
         res = []
         for o, r in zip(out_leaves, out_ranks):
             o = o[:B]
@@ -113,15 +129,18 @@ def _build():
     from .field import FN
 
     g = globals()
-    g["g1_add"] = bucketed(C.add, (2, 2), 2)
-    g["g1_neg"] = bucketed(C.neg, (2,), 2)
-    g["g1_scalar_mul"] = bucketed(C.scalar_mul, (2, 1), 2)
-    g["g1_eq"] = bucketed(C.eq, (2, 2), 0)
-    g["g1_normalize"] = bucketed(C.normalize, (2,), (1, 1, 0))
-    g["g2_scalar_mul"] = bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32)
+    g["g1_add"] = bucketed(C.add, (2, 2), 2, max_bucket=4096)
+    g["g1_neg"] = bucketed(C.neg, (2,), 2, max_bucket=4096)
+    g["g1_scalar_mul"] = bucketed(C.scalar_mul, (2, 1), 2, max_bucket=4096)
+    g["g1_eq"] = bucketed(C.eq, (2, 2), 0, max_bucket=4096)
+    g["g1_normalize"] = bucketed(C.normalize, (2,), (1, 1, 0),
+                                 max_bucket=4096)
+    g["g2_scalar_mul"] = bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32,
+                                  max_bucket=2048)
     g["g2_normalize"] = bucketed(G2.normalize, (3,), (2, 2, 0),
-                                 min_bucket=32)
-    g["fixed_base_mul"] = bucketed(eg.fixed_base_mul, (-1, 1), 2)
+                                 min_bucket=32, max_bucket=2048)
+    g["fixed_base_mul"] = bucketed(eg.fixed_base_mul, (-1, 1), 2,
+                                   max_bucket=4096)
     from . import pallas_ops as po
     from . import pallas_pairing as ppair
 
@@ -161,13 +180,19 @@ def _build():
             return ppair.final_exp_flat(f)
         return PAIR.final_exp(f)
 
-    g["pair"] = bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32)
-    g["miller"] = bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32)
-    g["gt_pow"] = bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32)
-    g["gt_pow64"] = bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32)
-    g["final_exp"] = bucketed(_final_exp_fn, (3,), 3, min_bucket=8)
-    g["gt_mul"] = bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32)
-    g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32)
+    g["pair"] = bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32,
+                         max_bucket=2048)
+    g["miller"] = bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32,
+                           max_bucket=2048)
+    g["gt_pow"] = bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32,
+                           max_bucket=2048)
+    g["gt_pow64"] = bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32,
+                             max_bucket=2048)
+    g["final_exp"] = bucketed(_final_exp_fn, (3,), 3, min_bucket=8,
+                              max_bucket=2048)
+    g["gt_mul"] = bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32,
+                           max_bucket=2048)
+    g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32, max_bucket=2048)
     g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1)
     g["fn_sub"] = bucketed(lambda a, b: F.sub(a, b, FN), (1, 1), 1)
     g["fn_neg"] = bucketed(lambda a: F.neg(a, FN), (1,), 1)

@@ -4,19 +4,28 @@ Reference semantics (lib/range/range_proof.go): a DP proves its ElGamal
 plaintext σ ∈ [0, u^l) by base-u digit decomposition (ToBase :584). Each CN
 publishes BB signatures A[k] = (x+k)^{-1}·B2 for k<u (InitRangeProofSignature
 :270-288); the proof blinds the digit signatures (V = v·A[φ] :392-394),
-commits D = Σ u^j s_j·B + m·P, and answers challenge
-c = sha3-512(B ‖ C ‖ ΣY) (:348-375) with Zphi, Zv, Zr; the verifier checks
+commits D = Σ u^j s_j·B + m·P, and answers challenge c with Zphi, Zv, Zr;
+the verifier checks
   D  == c·C + Zr·P + Σ u^j·Zphi_j·B                       (:519-529)
   a  == e(c·y − Zphi_j·B, V_ij) · e(B,B2)^{Zv_ij}         (:538-546)
 (the reference's three pairings per digit collapse to ONE pairing + one GT
 exponentiation here — same equation, shared bilinearity).
 
+Fiat-Shamir binding: the reference hashes only c = sha3-512(B ‖ C ‖ ΣY)
+(:348-375) and its verifier trusts the transmitted challenge — so a forger
+can fix c FIRST, choose Zphi/Zr/Zv/V freely, and *derive* D and a from the
+two verifier equations; every check passes for a ciphertext encrypting
+anything. This implementation closes that hole: the challenge is
+  c = sha3-512(B ‖ C2 ‖ ΣY ‖ u ‖ l ‖ D ‖ V_pts ‖ a)
+i.e. it binds ALL prover commitments (proper sigma-protocol Fiat-Shamir:
+commit, then hash, then respond), and verification REQUIRES the recomputed
+challenge to match. Deriving D or a post-hoc now changes c, which changes
+the equations they must satisfy — a hash-fixed-point search.
+
 TPU design: one proof BATCH covers a whole ciphertext vector (V values):
 digits, responses and blinded signatures are (ns, V, l, ...) limb tensors;
 the pairings run as one batched Miller-loop scan. Host work is only the
-Fiat-Shamir hash. Unlike the reference verifier (which trusts the transmitted
-challenge), verification recomputes c from the commitment — strictly
-stronger.
+Fiat-Shamir hash.
 """
 from __future__ import annotations
 
@@ -297,7 +306,8 @@ def gt_pow_gtb(k):
     if _GT_POW_GTB is None:
         tab = gt_base_table()
         _GT_POW_GTB = B.bucketed(
-            lambda kk: pp.gt_pow_fixed(tab, kk), (1,), 3, min_bucket=32)
+            lambda kk: pp.gt_pow_fixed(tab, kk), (1,), 3, min_bucket=32,
+            max_bucket=2048)
     return _GT_POW_GTB(k)
 
 
@@ -319,11 +329,31 @@ def _weighted_sum_mod_n(s_plain, upow_m):
     return acc
 
 
-def challenge_for_commits(cts, sum_y_bytes: np.ndarray) -> np.ndarray:
-    """c = sha3-512(B ‖ C2 ‖ ΣY) per value (range_proof.go:348-375)."""
+def proof_challenge(cts, sum_y_bytes: np.ndarray, d, v_pts, a,
+                    u: int, l: int) -> np.ndarray:
+    """Per-value Fiat-Shamir challenge binding ALL prover commitments:
+
+      c = sha3-512(B ‖ C2 ‖ ΣY ‖ u ‖ l ‖ D ‖ V_pts[·,v,·] ‖ a[·,v,·])
+
+    The reference hashes only (B ‖ commit ‖ ΣY) (range_proof.go:348-375),
+    which lets a forger derive D and a AFTER fixing c (see module
+    docstring). Binding D, the blinded signatures V and the pairing
+    commitments a makes the transcript a proper sigma-protocol
+    Fiat-Shamir transform.
+
+    d: (V, 3, 16) G1; v_pts: (ns, V, l, 3, 2, 16) G2;
+    a: (ns, V, l, 6, 2, 16) GT. All canonicalized (normalized affine
+    bytes) before hashing so creator and verifier agree bit-exactly.
+    """
     base_b = enc.g1_bytes(jnp.asarray(C.from_ref(refimpl.G1)))
     c2 = enc.g1_bytes(cts[..., 1, :, :])
-    return enc.hash_to_scalar(base_b, c2, sum_y_bytes,
+    ul = np.asarray([u, l], dtype=np.int64).view(np.uint8)
+    d_b = enc.g1_bytes(jnp.asarray(d))                       # (V, 64)
+    v_b = np.moveaxis(enc.g2_bytes(jnp.asarray(v_pts)), 0, 1)
+    v_b = np.ascontiguousarray(v_b).reshape(v_b.shape[0], -1)  # (V, ns*l*128)
+    a_b = np.moveaxis(enc.gt_bytes(jnp.asarray(a)), 0, 1)
+    a_b = np.ascontiguousarray(a_b).reshape(a_b.shape[0], -1)  # (V, ns*l*384)
+    return enc.hash_to_scalar(base_b, c2, sum_y_bytes, ul, d_b, v_b, a_b,
                               batch_shape=cts.shape[:-3])
 
 
@@ -339,13 +369,15 @@ def sum_publics_bytes(sigs: list[RangeSig]) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
+def _commit_kernel(digits, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
                    gtA=None):
-    """Device part of proof creation, built from bucketed primitives (each
-    compiles once per size bucket — see crypto/batching.py).
+    """Commitment stage of proof creation (independent of the challenge),
+    built from bucketed primitives (each compiles once per size bucket —
+    see crypto/batching.py).
 
-    digits (V, l) int32; c, rs (V, 16); s, t, m (V, l, 16); v (ns, V, l, 16);
+    digits (V, l) int32; s, t, m (V, l, 16); v (ns, V, l, 16);
     A_tab (ns, u, 3, 2, 16); ca_tbl: collective-key fixed-base table.
+    Returns D (V, 3, 16), m_tot (V, 16), V_pts, a.
     """
     from ..crypto import batching as B
     from ..crypto import pallas_ops as po
@@ -365,13 +397,6 @@ def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
         m_tot = B.fn_add(m_tot, m[..., j, :])
     D = B.g1_add(B.fixed_base_mul(base_tbl, w),
                  B.fixed_base_mul(ca_tbl, m_tot))
-
-    # Zphi_j = s_j − c·φ_j ; Zr = Σm − c·r
-    phi = eg.int_to_scalar(digits.astype(jnp.int64))      # (V, l, 16)
-    c_l = c[..., None, :]
-    zphi = B.fn_sub(s, B.fn_mul_plain(c_l, phi))
-    zr = B.fn_sub(m_tot, B.fn_mul_plain(c, rs))
-
     sync(D)
 
     # V_ij = v_ij · A_i[φ_j]  — gather digit signatures, blind in G2
@@ -397,10 +422,20 @@ def _create_kernel(digits, c, rs, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
     gt2 = gt_pow_gtb(t)                                    # (V, l, 6, 2, 16)
     a = B.gt_mul(gt1, gt2)
 
-    # Zv_ij = t_j − c·v_ij
-    zv = B.fn_sub(t, B.fn_mul_plain(c_l, v))
+    return D, m_tot, V_pts, a
 
-    return D, zphi, zr, V_pts, a, zv
+
+def _response_kernel(digits, c, rs, s, t, m_tot, v):
+    """Response stage: given the bound challenge c, compute
+    Zphi_j = s_j − c·φ_j, Zr = Σm − c·r, Zv_ij = t_j − c·v_ij."""
+    from ..crypto import batching as B
+
+    phi = eg.int_to_scalar(digits.astype(jnp.int64))      # (V, l, 16)
+    c_l = c[..., None, :]
+    zphi = B.fn_sub(s, B.fn_mul_plain(c_l, phi))
+    zr = B.fn_sub(m_tot, B.fn_mul_plain(c, rs))
+    zv = B.fn_sub(t, B.fn_mul_plain(c_l, v))
+    return zphi, zr, zv
 
 
 def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
@@ -419,8 +454,7 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     """
     V = int(np.asarray(secrets).shape[0])
     ns = len(sigs)
-    digits = to_base(np.asarray(secrets), u, l)            # (V, l)
-    c = jnp.asarray(challenge_for_commits(cts, sum_publics_bytes(sigs)))
+    digits = jnp.asarray(to_base(np.asarray(secrets), u, l))  # (V, l)
 
     ks = jax.random.split(key, 4)
     s = eg.random_scalars(ks[0], (V, l))
@@ -430,9 +464,13 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     A_tab = jnp.asarray(np.stack([sg.A for sg in sigs]))   # (ns, u, 3, 2, 16)
     gtA = sig_gt_table(sigs) if use_gt_table else None
 
-    D, zphi, zr, V_pts, a, zv = _create_kernel(
-        jnp.asarray(digits), c, jnp.asarray(rs), s, t, m, v, A_tab,
-        ca_pub_table, u, l, gtA=gtA)
+    # commit -> Fiat-Shamir (binds D, V_pts, a) -> respond
+    D, m_tot, V_pts, a = _commit_kernel(
+        digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA)
+    c = jnp.asarray(proof_challenge(cts, sum_publics_bytes(sigs),
+                                    D, V_pts, a, u, l))
+    zphi, zr, zv = _response_kernel(digits, c, jnp.asarray(rs), s, t,
+                                    m_tot, v)
     return RangeProofBatch(commit=jnp.asarray(cts), challenge=c, zr=zr, d=D,
                            zphi=zphi, zv=zv, v_pts=V_pts, a=a, u=u, l=l)
 
@@ -481,8 +519,10 @@ def verify_range_proofs(proof: RangeProofBatch, sigs_pub, ca_pub_table,
                         check_challenge: bool = True) -> np.ndarray:
     """Verify a proof batch against server publics (host affine int pairs).
 
-    Returns bool (V,). (Reference RangeProofVerification :504-565; unlike it
-    we also recompute the Fiat-Shamir challenge.)
+    Returns bool (V,). (Reference RangeProofVerification :504-565; unlike
+    it — which trusts the transmitted challenge — the recomputed
+    Fiat-Shamir challenge over D ‖ V_pts ‖ a MUST match; this is the
+    soundness-critical binding, see module docstring.)
     """
     ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]))
     ok = np.asarray(_verify_kernel(
@@ -495,11 +535,15 @@ def verify_range_proofs(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 
 
 def _challenge_ok(proof: RangeProofBatch, sigs_pub) -> np.ndarray:
+    """Recompute c = H(B ‖ C2 ‖ ΣY ‖ u ‖ l ‖ D ‖ V ‖ a) from the
+    TRANSMITTED commitments and require equality with the transmitted
+    challenge — a forger deriving D or a post-hoc changes c."""
     acc = None
     for p in sigs_pub:
         acc = refimpl.g1_add(acc, p)
-    want = challenge_for_commits(proof.commit, enc.g1_bytes(
-        jnp.asarray(C.from_ref(acc))))
+    want = proof_challenge(proof.commit, enc.g1_bytes(
+        jnp.asarray(C.from_ref(acc))), proof.d, proof.v_pts, proof.a,
+        proof.u, proof.l)
     return np.all(np.asarray(proof.challenge) == want, axis=-1)
 
 
@@ -513,13 +557,24 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 
     Checks prod_ij [ e(r_ij*(c*y_i - Zphi_j*B), V_ij) * conj6(a_ij)^r_ij ]
            * gtB^(sum_ij r_ij*Zv_ij)  ==  1
-    with verifier-secret 63-bit weights r_ij. Soundness: a batch with any
-    forged element passes with prob <= ~2^-63 (Schwartz-Zippel over the
-    exponent group; same argument as the shuffle proof's RLC). conj6 gives
-    a^-1 for honest (cyclotomic) a; for adversarial a outside the
-    cyclotomic subgroup the check accepts only when a equals the cyclotomic
-    a', since conj6 is an involutive automorphism, so conj6(a)*a' == 1
-    forces a == conj6(1/a') == a'.
+    with verifier-secret 62-bit weights r_ij.
+
+    Soundness (REQUIRES check_challenge=True — the service path always
+    passes it): the Fiat-Shamir hash binds a (and D, V) BEFORE c is known,
+    so the per-digit factor f_ij = e(c*y_i - Zphi_j*B, V_ij) *
+    gtB^Zv_ij * conj6(a_ij) is fully determined by the transcript. For
+    honest (cyclotomic, satisfying) a, conj6(a) = a^-1 and every f_ij = 1.
+    A forged transcript has some f_ij != 1, and the check passes only if
+    sum_ij r_ij * x_ij = 0 in the exponent lattice (x_ij = dlog of f_ij in
+    the subgroup it generates): probability <= 2^-62 per independent r,
+    unless f_ij has small order d (then 1/d). Making f_ij small-order,
+    however, requires a = conj6(eps * v(H(...||a))^-1) for a d-th root of
+    unity eps — a fixed point of sha3-512, since a is an input of the hash
+    that determines c and hence v. Without the challenge binding (round-2
+    state) the adversary could choose a freely AFTER c and hit eps = -1
+    with probability 1/2 per attempt — that attack now fails
+    deterministically at the challenge recompute (regression-tested by
+    test_rlc_small_order_forgery_rejected).
 
     The D-equation and Fiat-Shamir challenge are still checked per value
     (cheap G1 work). Returns one bool for the batch.
@@ -529,27 +584,14 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 
     sync = jax.block_until_ready if po.available() else (lambda x: x)
     ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]))
-    commit, c, zphi, zv = (jnp.asarray(proof.commit), proof.challenge,
-                           proof.zphi, proof.zv)
-    u, l = proof.u, proof.l
-    ns = len(sigs_pub)
-    V = proof.n_values
+    c, zphi = proof.challenge, proof.zphi
     base_tbl = eg.BASE_TABLE.table
-    upow_m = _upow_mont(u, l)
 
-    # D' = c·C2 + Zr·P + (Σ u^j Zphi_j)·B == D, per value
-    C2 = commit[..., 1, :, :]
-    wz = _weighted_sum_mod_n(zphi, upow_m)
-    Dp = B.g1_add(B.g1_scalar_mul(C2, c),
-                  B.g1_add(B.fixed_base_mul(ca_pub_table, proof.zr),
-                           B.fixed_base_mul(base_tbl, wz)))
-    d_ok = bool(np.all(np.asarray(B.g1_eq(Dp, proof.d))))
-    sync(Dp)
-
-    if rng is None:
-        rng = np.random.default_rng(
-            np.frombuffer(secrets.token_bytes(16), dtype=np.uint64))
-    r_int = rng.integers(1, 1 << 62, size=(ns, V, l), dtype=np.int64)
+    pre_ok, r_int, gtb_pow_s = rlc_prelude(
+        proof, sigs_pub, ca_pub_table, rng=rng,
+        check_challenge=check_challenge)
+    if not pre_ok:
+        return False  # D equation / challenge binding failed — deterministic
     r = B.int_to_scalar(jnp.asarray(r_int))               # (ns, V, l, 16)
 
     # r·(c·y_i − Zphi_j·B), then Miller only (final exp shared)
@@ -572,16 +614,55 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
         m.reshape(-1, 6, 2, params.NUM_LIMBS))[None])
     Pa = B.gt_reduce_prod(ar.reshape(-1, 6, 2, params.NUM_LIMBS))
 
-    # gtB^(Σ r·Zv): one fixed-base power
-    rs_zv = B.fn_mul_plain(r, zv).reshape(-1, params.NUM_LIMBS)
-    S = B.tree_reduce_add(rs_zv, B.fn_add, axis=0)
-    total = B.gt_mul(B.gt_mul(fe, Pa[None]), gt_pow_gtb(S[None]))[0]
-    a_ok = bool(np.asarray(F12.eq(total, jnp.asarray(F12.one()))))
+    # gtB^(Σ r·Zv) comes from the shared prelude (one fixed-base power)
+    total = B.gt_mul(B.gt_mul(fe, Pa[None]), gtb_pow_s[None])[0]
+    return bool(np.asarray(F12.eq(total, jnp.asarray(F12.one()))))
 
-    ok = d_ok and a_ok
+
+def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
+                rng: np.random.Generator | None = None,
+                check_challenge: bool = True, with_gtb_pow: bool = True):
+    """The RLC verifiers' shared acceptance preamble — kept in ONE place so
+    the single-device path (verify_range_proofs_batch) and the mesh-sharded
+    path (parallel/proof_mesh.rlc_verify_sharded) cannot drift apart on the
+    soundness-critical checks:
+
+      * per-value D equation  D == c*C2 + Zr*P + (sum u^j Zphi_j)*B
+      * binding Fiat-Shamir challenge recompute over D ‖ V ‖ a
+      * verifier-secret 62-bit RLC weights r
+      * [with_gtb_pow] gtB^(sum_ij r_ij*Zv_ij), the one fixed-base power
+
+    Returns (pre_ok, r_int, gtb_pow_s) with gtb_pow_s None unless
+    requested."""
+    from ..crypto import batching as B
+
+    base_tbl = eg.BASE_TABLE.table
+    u, l = proof.u, proof.l
+    ns, V = len(sigs_pub), proof.n_values
+    upow_m = _upow_mont(u, l)
+
+    C2 = jnp.asarray(proof.commit)[..., 1, :, :]
+    wz = _weighted_sum_mod_n(proof.zphi, upow_m)
+    Dp = B.g1_add(B.g1_scalar_mul(C2, proof.challenge),
+                  B.g1_add(B.fixed_base_mul(ca_pub_table, proof.zr),
+                           B.fixed_base_mul(base_tbl, wz)))
+    ok = bool(np.all(np.asarray(B.g1_eq(Dp, proof.d))))
     if check_challenge:
         ok = ok and bool(np.all(_challenge_ok(proof, sigs_pub)))
-    return ok
+
+    if rng is None:
+        rng = np.random.default_rng(
+            np.frombuffer(secrets.token_bytes(16), dtype=np.uint64))
+    r_int = rng.integers(1, 1 << 62, size=(ns, V, l), dtype=np.int64)
+
+    gtb_pow_s = None
+    if with_gtb_pow:
+        r = B.int_to_scalar(jnp.asarray(r_int))
+        rs_zv = B.fn_mul_plain(r, jnp.asarray(proof.zv)).reshape(
+            -1, params.NUM_LIMBS)
+        S = B.tree_reduce_add(rs_zv, B.fn_add, axis=0)
+        gtb_pow_s = gt_pow_gtb(S[None])[0]
+    return ok, r_int, gtb_pow_s
 
 
 
@@ -660,15 +741,62 @@ def create_range_proof_list(key, secrets, rs, cts, ranges,
     return RangeProofList(n_values=len(ranges), batches=batches)
 
 
-def verify_range_proof_list(lst: RangeProofList, ranges,
-                            sigs_pub_by_u: dict, ca_pub_table) -> bool:
-    """Verify a mixed-range payload against the QUERY's specs: every output
-    index with a nonzero (u, l) must be covered by exactly one batch carrying
-    that exact spec (a prover cannot substitute a looser range), and every
-    batch must verify."""
+def _slice_batch(pb: RangeProofBatch, sel: np.ndarray) -> RangeProofBatch:
+    """Sub-batch along the value axis (proofs are per-value independent)."""
+    sel = jnp.asarray(sel)
+    return RangeProofBatch(
+        commit=jnp.asarray(pb.commit)[sel], challenge=pb.challenge[sel],
+        zr=pb.zr[sel], d=pb.d[sel], zphi=pb.zphi[sel],
+        zv=pb.zv[:, sel], v_pts=pb.v_pts[:, sel], a=pb.a[:, sel],
+        u=pb.u, l=pb.l)
+
+
+def create_range_proof_lists_batched(key, secrets_2d, rs_2d, cts_2d, ranges,
+                                     sigs_by_u: dict,
+                                     ca_pub_table) -> list:
+    """All DPs' payloads in ONE device-batched creation (the single-chip
+    harness path: n_dps DPs share the chip, so their per-value-independent
+    proofs vectorize into one kernel chain instead of n_dps serialized
+    ones — the reference's DPs parallelize the same work across machines,
+    data_collection_protocol.go:279-347).
+
+    secrets_2d: (n_dps, V); rs_2d: (n_dps, V, 16); cts_2d: (n_dps, V, 2, 3,
+    16); ranges: per-output (u, l) specs (shared by every DP). Returns
+    [RangeProofList] per DP, each byte-compatible with per-DP creation
+    (same per-value transcripts — the Fiat-Shamir challenge hash is
+    per-value, so batching does not change any proof)."""
+    secrets_2d = np.asarray(secrets_2d)
+    n_dps, V = secrets_2d.shape
+    flat_ranges = list(ranges) * n_dps
+    big = create_range_proof_list(
+        key, secrets_2d.reshape(-1), jnp.asarray(rs_2d).reshape(-1, 16),
+        jnp.asarray(cts_2d).reshape(-1, 2, 3, 16), flat_ranges, sigs_by_u,
+        ca_pub_table)
+    out = []
+    for d in range(n_dps):
+        batches = []
+        for ia, pb in big.batches:
+            ia = np.asarray(ia)
+            mine = (ia // V) == d
+            if not np.any(mine):
+                continue
+            local_idx = (ia[mine] % V).astype(np.int64)
+            batches.append((local_idx, _slice_batch(pb, np.nonzero(mine)[0])))
+        out.append(RangeProofList(n_values=V, batches=batches))
+    return out
+
+
+def _list_structure_ok(lst: RangeProofList, ranges,
+                       sigs_pub_by_u: dict) -> bool:
+    """Coverage check: every output index with a nonzero (u, l) spec must be
+    covered by exactly one batch carrying that exact spec (a prover cannot
+    substitute a looser range), and every batch's base must have published
+    signatures."""
     want = group_ranges(ranges)
     covered = {}
     for ia, pb in lst.batches:
+        if sigs_pub_by_u.get(pb.u) is None:
+            return False
         for i in ia:
             if int(i) in covered:
                 return False
@@ -677,21 +805,99 @@ def verify_range_proof_list(lst: RangeProofList, ranges,
         for i in idx:
             if covered.get(i) != (u, l):
                 return False
-    if set(covered) != {i for idx in want.values() for i in idx}:
+    return set(covered) == {i for idx in want.values() for i in idx}
+
+
+def verify_range_proof_list(lst: RangeProofList, ranges,
+                            sigs_pub_by_u: dict, ca_pub_table) -> bool:
+    """Verify a mixed-range payload against the QUERY's specs (structure +
+    every batch's RLC check)."""
+    if not _list_structure_ok(lst, ranges, sigs_pub_by_u):
         return False
     for ia, pb in lst.batches:
-        pubs = sigs_pub_by_u.get(pb.u)
-        if pubs is None:
-            return False
-        if not verify_range_proofs_batch(pb, pubs, ca_pub_table):
+        if not verify_range_proofs_batch(pb, sigs_pub_by_u[pb.u],
+                                         ca_pub_table):
             return False
     return True
+
+
+def _concat_batches(pbs: list) -> RangeProofBatch:
+    """Concatenate same-spec batches along the value axis."""
+    u, l = pbs[0].u, pbs[0].l
+    assert all(pb.u == u and pb.l == l for pb in pbs)
+    cat = lambda xs, ax: jnp.concatenate([jnp.asarray(x) for x in xs], ax)
+    return RangeProofBatch(
+        commit=cat([pb.commit for pb in pbs], 0),
+        challenge=cat([pb.challenge for pb in pbs], 0),
+        zr=cat([pb.zr for pb in pbs], 0),
+        d=cat([pb.d for pb in pbs], 0),
+        zphi=cat([pb.zphi for pb in pbs], 0),
+        zv=cat([pb.zv for pb in pbs], 1),
+        v_pts=cat([pb.v_pts for pb in pbs], 1),
+        a=cat([pb.a for pb in pbs], 1), u=u, l=l)
+
+
+def verify_range_proof_payloads_joint(datas: list, ranges,
+                                      sigs_pub_by_u: dict,
+                                      ca_pub_table) -> list[bool]:
+    """Joint verification from RAW payload bytes: each payload deserializes
+    in its own guard so one malformed (malicious) payload fails only
+    itself — never its honest neighbours."""
+    lists: list = []
+    idx: list = []
+    out = [False] * len(datas)
+    for i, d in enumerate(datas):
+        try:
+            lists.append(RangeProofList.from_bytes(d))
+            idx.append(i)
+        except Exception:
+            from ..utils import log
+
+            log.warn(f"range payload {i}: malformed bytes, rejected")
+    if lists:
+        for i, ok in zip(idx, verify_range_proof_lists_joint(
+                lists, ranges, sigs_pub_by_u, ca_pub_table)):
+            out[i] = ok
+    return out
+
+
+def verify_range_proof_lists_joint(lists: list, ranges, sigs_pub_by_u: dict,
+                                   ca_pub_table) -> list[bool]:
+    """Joint verification of MANY payloads (one per DP): structural checks
+    per payload, then ONE RLC batch verification per (u, l) spec over the
+    concatenation of every structurally-valid payload's values — a VN
+    verifying 10 DPs' proofs pays one shared final exponentiation instead
+    of 10 (sound: the RLC weights are drawn across the whole concatenation,
+    and each per-value transcript is independent). On a joint failure,
+    falls back to per-payload verification so honest payloads are not
+    penalized for a neighbour's forgery. Returns one bool per payload."""
+    ok_struct = [_list_structure_ok(lst, ranges, sigs_pub_by_u)
+                 for lst in lists]
+    idx_valid = [i for i, ok in enumerate(ok_struct) if ok]
+    if not idx_valid:
+        return ok_struct
+
+    by_spec: dict = {}
+    for i in idx_valid:
+        for _ia, pb in lists[i].batches:
+            by_spec.setdefault((pb.u, pb.l), []).append(pb)
+    joint_ok = all(
+        verify_range_proofs_batch(_concat_batches(pbs),
+                                  sigs_pub_by_u[u], ca_pub_table)
+        for (u, _l), pbs in by_spec.items())
+    if joint_ok:
+        return ok_struct
+    return [ok_struct[i] and verify_range_proof_list(
+        lists[i], ranges, sigs_pub_by_u, ca_pub_table)
+        for i in range(len(lists))]
 
 
 __all__ = ["RangeSig", "init_range_sig", "sig_gt_table", "to_base",
            "RangeProofBatch",
            "RangeProofList", "group_ranges", "create_range_proofs",
-           "create_range_proof_list", "verify_range_proofs",
-           "verify_range_proofs_batch",
-           "verify_range_proof_list", "challenge_for_commits", "gt_base",
+           "create_range_proof_list", "create_range_proof_lists_batched",
+           "verify_range_proofs", "verify_range_proofs_batch",
+           "verify_range_proof_list", "verify_range_proof_lists_joint",
+           "verify_range_proof_payloads_joint", "rlc_prelude",
+           "proof_challenge", "gt_base",
            "gt_base_table", "gt_pow_gtb", "sum_publics_bytes"]

@@ -85,7 +85,7 @@ def verify_proof_request(req: ProofRequest, sender_pub,
     payload verification). `verify_payload(data, survey_id)` — the survey id
     lets the verifier fetch the query's expected parameters (e.g. per-value
     range specs, lib/structs.go:446-533)."""
-    if not schnorr.verify(sender_pub, req.signed_payload(), req.signature):
+    if not verify_signature(req, sender_pub):
         return BM_BADSIG
     if verify_payload is None or float(rng.random()) > sample:
         return BM_RECVD
@@ -107,5 +107,11 @@ def verify_proof_request(req: ProofRequest, sender_pub,
     return BM_TRUE if ok else BM_FALSE
 
 
+def verify_signature(req: ProofRequest, sender_pub) -> bool:
+    """Signature-only check (reference VerifyProofSignature :498-505)."""
+    return schnorr.verify(sender_pub, req.signed_payload(), req.signature)
+
+
 __all__ = ["BM_FALSE", "BM_TRUE", "BM_RECVD", "BM_BADSIG", "PROOF_TYPES",
-           "ProofRequest", "new_proof_request", "verify_proof_request"]
+           "ProofRequest", "new_proof_request", "verify_proof_request",
+           "verify_signature"]

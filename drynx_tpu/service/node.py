@@ -157,6 +157,14 @@ class DrynxNode:
         s.register("vn_register", self._h_vn_register)
         s.register("vn_bitmap", self._h_vn_bitmap)
         s.register("end_verification", self._h_end_verification)
+        # skipchain retrieval RPCs (reference serves genesis/latest/specific
+        # block + stored proofs + close-DB to REMOTE clients,
+        # services/service_skipchain.go:173-342)
+        s.register("get_genesis", self._h_get_block)
+        s.register("get_latest", self._h_get_block)
+        s.register("get_block", self._h_get_block)
+        s.register("get_proofs", self._h_get_proofs)
+        s.register("close_db", self._h_close_db)
         s.register("ping", lambda m: {"ok": True, "name": self.name})
 
     # ------------------------------------------------------------------
@@ -198,6 +206,14 @@ class DrynxNode:
                 lst, ctx["ranges_v"], ctx["sigs_pub_by_u"],
                 self._pub_table(ctx["coll_pub"]).table)
 
+        def vrange_joint(datas: list, sid: str) -> list:
+            ctx = ctx_of(sid)
+            if ctx is None:
+                return [False] * len(datas)
+            return rproof.verify_range_proof_payloads_joint(
+                datas, ctx["ranges_v"], ctx["sigs_pub_by_u"],
+                self._pub_table(ctx["coll_pub"]).table)
+
         def vagg(data: bytes, _sid: str) -> bool:
             return bool(np.all(agg_proof.verify_aggregation_proof(
                 safe_loads(data))))
@@ -223,7 +239,8 @@ class DrynxNode:
                 proof, jnp.asarray(in_cts), jnp.asarray(out_cts),
                 jnp.asarray(C.from_ref(ctx["coll_pub"])))
 
-        return {"range": vrange, "aggregation": vagg, "obfuscation": vobf,
+        return {"range": vrange, "range_joint": vrange_joint,
+                "aggregation": vagg, "obfuscation": vobf,
                 "keyswitch": vks, "shuffle": vshuffle}
 
     # ------------------------------------------------------------------
@@ -305,15 +322,63 @@ class DrynxNode:
 
     # -- DP side: encode + encrypt local data (survey_dp); with proofs on,
     # fire the range-proof list at the VNs from THIS process (reference
-    # service_data_provider.go:48 generateRangePI)
+    # service_data_provider.go:48 generateRangePI). Carries the FULL
+    # encoder surface over the wire like the reference GenerateData
+    # (data_collection_protocol.go:206-267): log_reg ((X, y) DP data +
+    # LRParams + the signed-offset shift) and group-by (per-group encoding
+    # over the AllPossibleGroups grid).
     def _h_survey_dp(self, msg: dict) -> dict:
         op = msg["op"]
         qmin, qmax = msg["query_min"], msg["query_max"]
-        data = self.data
-        if data is None:
-            rng = np.random.default_rng(abs(hash(self.name)) % 2**31)
-            data = rng.integers(qmin, max(qmax, 1), size=(32,)).astype(np.int64)
-        stats = np.asarray(st.encode_clear(op, data, qmin, qmax))
+        group_by = msg.get("group_by") or None
+        rng = np.random.default_rng(abs(hash(self.name)) % 2**31)
+        if op == "log_reg":
+            from ..models import logreg as lr
+
+            lrp = lr.LRParams(**{
+                k: (tuple(v) if isinstance(v, list) else v)
+                for k, v in msg["lr_params"].items()})
+            if not (isinstance(self.data, tuple) and len(self.data) == 2):
+                raise RuntimeError(
+                    f"DP {self.name}: log_reg survey but node data is not "
+                    "an (X, y) tuple")
+            X, y = self.data
+            stats = np.asarray(lr.encode_clear(X, y, lrp)).reshape(-1)
+        elif group_by:
+            # node data for grouped queries: (values, group_labels); dummy
+            # labels when absent (reference createFakeDataForOperation)
+            if isinstance(self.data, tuple):
+                data, groups = self.data
+            else:
+                data, groups = self.data, None
+            if data is None:
+                data = rng.integers(qmin, max(qmax, 1),
+                                    size=(32,)).astype(np.int64)
+            if groups is None:
+                groups = np.stack(
+                    [rng.choice(np.asarray(vals), size=len(data))
+                     for vals in group_by], axis=-1).astype(np.int64)
+            grid = st.group_grid(group_by)
+            # group-major flatten — aligned group axis makes element-wise
+            # homomorphic addition the per-group aggregation
+            stats = np.asarray(st.encode_clear_grouped(
+                op, data, groups, grid, qmin, qmax)).reshape(-1)
+        else:
+            data = self.data
+            if data is None:
+                data = rng.integers(qmin, max(qmax, 1),
+                                    size=(32,)).astype(np.int64)
+            stats = np.asarray(st.encode_clear(op, data, qmin, qmax))
+        # signed-encoding shift (sound range proofs for negative logreg
+        # fixed-point coefficients; the root CN subtracts n_dps*offset
+        # after key switch — mirrors service.py run_survey)
+        range_offset = int(msg.get("range_offset", 0))
+        if range_offset:
+            if int(np.abs(stats).max()) >= range_offset:
+                raise RuntimeError(
+                    f"DP {self.name}: encoding exceeds range-proof bound "
+                    f"u^l/2 = {range_offset}")
+            stats = stats + range_offset
         tbl = self._pub_table(self.roster.collective_pub())
         # fresh OS entropy: blinding scalars must never be derivable from
         # survey metadata, and must differ across runs of the same survey
@@ -430,12 +495,16 @@ class DrynxNode:
 
         # collect encrypted DP responses (star topology); DPs fire range
         # proofs at the VNs from their own processes
+        range_offset = int(msg.get("range_offset", 0))
         cts = []
         for e in dps:
             r = call_entry(e, {"type": "survey_dp", "op": op,
                                "survey_id": survey_id,
                                "query_min": msg["query_min"],
                                "query_max": msg["query_max"],
+                               "lr_params": msg.get("lr_params"),
+                               "group_by": msg.get("group_by"),
+                               "range_offset": range_offset,
                                "proofs": proofs, "ranges": ranges_v,
                                "range_sigs": range_sigs_msg})
             cts.append(unpack_array(r["cts"]))
@@ -490,7 +559,17 @@ class DrynxNode:
             k_sum = u if k_sum is None else B.g1_add(k_sum, u)
             c_sum = w if c_sum is None else B.g1_add(c_sum, w)
 
-        switched = jnp.stack([k_sum, B.g1_add(agg[:, 1], c_sum)], axis=-3)
+        c2 = B.g1_add(agg[:, 1], c_sum)
+        if range_offset:
+            # subtract the public aggregate shift (n_dps * u^l/2)·B so the
+            # decrypted values are the true signed statistics
+            total = range_offset * len(dps)
+            assert total < 2 ** 62, "offset too large for int64 scalar path"
+            corr = B.fixed_base_mul(
+                eg.BASE_TABLE.table,
+                B.int_to_scalar(jnp.asarray([total], dtype=jnp.int64)))
+            c2 = B.g1_add(c2, B.g1_neg(jnp.broadcast_to(corr[0], c2.shape)))
+        switched = jnp.stack([k_sum, c2], axis=-3)
         # let this node's own proof threads drain before replying so the
         # querier's end_verification doesn't race local stragglers
         with self._state_lock:
@@ -506,7 +585,9 @@ class DrynxNode:
                                "not in the vn role)")
         sid = msg["survey_id"]
         self.vn.register_survey(sid, msg["expected"],
-                                msg.get("thresholds", {}))
+                                msg.get("thresholds", {}),
+                                expected_range=int(
+                                    msg.get("expected_range", 0)))
         if msg.get("proofs"):
             sigs_pub_by_u = {
                 int(u): [tuple(int(t) for t in p) for p in pubs]
@@ -591,6 +672,43 @@ class DrynxNode:
                 "bitmap": merged}
 
 
+    # -- VN skipchain retrieval handlers (reference
+    # services/service_skipchain.go:173-342: HandleGetGenesisBlock :173,
+    # HandleGetLatestBlock :204, HandleGetBlock :226, HandleGetProofs :240,
+    # HandleCloseDB :324) — a REMOTE querier can audit the chain.
+    def _require_vn(self) -> VerifyingNode:
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN")
+        return self.vn
+
+    def _h_get_block(self, msg: dict) -> dict:
+        vn = self._require_vn()
+        t = msg["type"]
+        if t == "get_genesis":
+            blk = vn.chain.genesis()
+        elif t == "get_latest":
+            blk = vn.chain.latest()
+        elif "survey_id" in msg:
+            blk = vn.chain.block_for_survey(msg["survey_id"])
+        else:
+            blk = vn.chain.block(int(msg["index"]))
+        if blk is None:
+            return {"found": False}
+        return {"found": True, "block": _pack_bytes(blk.to_bytes()),
+                "hash": blk.hash(), "chain_length": len(vn.chain)}
+
+    def _h_get_proofs(self, msg: dict) -> dict:
+        vn = self._require_vn()
+        stored = vn.stored_proofs(msg["survey_id"])
+        return {"proofs": {k: _pack_bytes(v) for k, v in stored.items()}}
+
+    def _h_close_db(self, msg: dict) -> dict:
+        vn = self._require_vn()
+        vn.db.sync()
+        vn.db.close()
+        return {"ok": True}
+
+
 class RemoteClient:
     """Querier for a multi-process deployment."""
 
@@ -627,22 +745,53 @@ class RemoteClient:
                    dlog: Optional[eg.DecryptionTable] = None,
                    proofs: bool = False, ranges=None,
                    obfuscation: bool = False, diffp: Optional[dict] = None,
+                   lr_params=None, group_by=None,
                    thresholds: float = 1.0, timeout: float = 300.0):
         """Full remote survey. With proofs on: collect range-sig publics from
         the CNs, register the survey (+ verify context) at every VN, run the
         query, then block on the root VN's counter-gated audit block
         (reference SendSurveyQueryToVNs + SendEndVerification,
-        services/api_skipchain.go:16-46). Returns (result, block_info)."""
+        services/api_skipchain.go:16-46). Returns (result, block_info).
+
+        op == "log_reg" requires lr_params (an LRParams) and each DP process
+        holding (X, y) data; group_by runs grouped encoding at every DP over
+        the AllPossibleGroups grid (reference GenerateData handles both over
+        the real network, data_collection_protocol.go:206-267)."""
+        from ..encoding import output_size
+
         cns = self.roster.of_role("cn")
         dps = self.roster.of_role("dp")
         vns = self.roster.of_role("vn")
         root = cns[0]
 
+        if op == "log_reg" and lr_params is None:
+            raise ValueError("log_reg survey requires lr_params")
+        if op == "log_reg" and group_by:
+            raise ValueError("group_by is not supported for log_reg")
+        n_groups = 1
+        if group_by:
+            n_groups = int(np.prod([len(v) for v in group_by]))
+        if op == "log_reg":
+            n_out = lr_params.num_coeffs()
+        else:
+            n_out = output_size(op, query_min, query_max) * n_groups
+
+        range_offset = 0
         if proofs:
             if ranges is None:
-                from ..encoding import output_size
-
-                ranges = [(16, 4)] * output_size(op, query_min, query_max)
+                ranges = [(16, 4)] * n_out
+            elif group_by and len(ranges) == n_out // n_groups:
+                ranges = list(ranges) * n_groups  # tile per-group specs
+            if len(ranges) != n_out:
+                raise ValueError(
+                    f"{len(ranges)} range specs for {n_out} outputs")
+            if op == "log_reg":
+                if len(set(map(tuple, ranges))) > 1:
+                    raise ValueError(
+                        "log_reg range proofs require a uniform (u, l) spec")
+                u0, l0 = ranges[0]
+                if u0:
+                    range_offset = (int(u0) ** int(l0)) // 2
             if not vns:
                 raise ValueError("proofs on but the roster has no VNs")
             from ..proofs.range_proof import group_ranges
@@ -660,11 +809,16 @@ class RemoteClient:
                 call_entry(e, {
                     "type": "vn_register", "survey_id": survey_id,
                     "expected": expected, "proofs": True,
+                    "expected_range": len(dps),
                     "thresholds": {t: thresholds for t in rq.PROOF_TYPES},
                     "client_pub": list(self.public),
                     "ranges": [list(r) for r in ranges],
                     "range_sig_pubs": sig_pubs})
 
+        lrp_msg = None
+        if lr_params is not None:
+            lrp_msg = {k: (list(v) if isinstance(v, tuple) else v)
+                       for k, v in dataclasses.asdict(lr_params).items()}
         r = call_entry(root, {"type": "survey_query", "op": op,
                               "survey_id": survey_id,
                               "query_min": query_min,
@@ -673,6 +827,10 @@ class RemoteClient:
                               "ranges": [list(t) for t in ranges or []],
                               "obfuscation": obfuscation,
                               "diffp": diffp,
+                              "lr_params": lrp_msg,
+                              "group_by": [list(v) for v in group_by]
+                              if group_by else None,
+                              "range_offset": range_offset,
                               "client_pub": list(self.public)},
                        timeout=max(timeout, 900.0))
         switched = jnp.asarray(unpack_array(r["switched"]))
@@ -684,7 +842,16 @@ class RemoteClient:
         dec = st.DecryptedVector(values=np.asarray(vals),
                                  found=np.asarray(found),
                                  is_zero=np.asarray(zeros))
-        result = st.decode(op, dec, query_min, query_max)
+        if op == "log_reg":
+            from ..models import logreg as lr
+
+            Ts = lr.unpack(jnp.asarray(dec.values), lr_params)
+            result = np.asarray(lr.train(Ts, lr_params))
+        elif group_by:
+            result = st.decode_grouped(op, dec, st.group_grid(group_by),
+                                       query_min, query_max)
+        else:
+            result = st.decode(op, dec, query_min, query_max)
         if not proofs:
             return result
 
@@ -695,6 +862,47 @@ class RemoteClient:
                                     "timeout": timeout},
                            timeout=2 * timeout + 120.0)
         return result, block
+
+    # -- remote skipchain audit (reference api_skipchain.go:48-106:
+    # SendGetGenesis/SendGetBlock/SendGetLatestBlock/SendGetProofs + close)
+    def _root_vn(self):
+        vns = self.roster.of_role("vn")
+        if not vns:
+            raise ValueError("roster has no VNs")
+        return vns[0]
+
+    @staticmethod
+    def _block_of(r: dict):
+        from .skipchain import Block
+
+        return Block.from_bytes(_unpack_bytes(r["block"])) \
+            if r.get("found") else None
+
+    def get_genesis(self):
+        return self._block_of(call_entry(self._root_vn(),
+                                         {"type": "get_genesis"}))
+
+    def get_latest(self):
+        return self._block_of(call_entry(self._root_vn(),
+                                         {"type": "get_latest"}))
+
+    def get_block(self, index: int = None, survey_id: str = None):
+        msg = {"type": "get_block"}
+        if survey_id is not None:
+            msg["survey_id"] = survey_id
+        else:
+            msg["index"] = int(index)
+        return self._block_of(call_entry(self._root_vn(), msg))
+
+    def get_proofs(self, survey_id: str) -> dict[str, bytes]:
+        """Stored proof bytes for a survey, keyed like the VN's proofdb."""
+        r = call_entry(self._root_vn(), {"type": "get_proofs",
+                                         "survey_id": survey_id})
+        return {k: _unpack_bytes(v) for k, v in r["proofs"].items()}
+
+    def close_db(self) -> None:
+        for e in self.roster.of_role("vn"):
+            call_entry(e, {"type": "close_db"})
 
 
 __all__ = ["RosterEntry", "Roster", "DrynxNode", "RemoteClient"]

@@ -168,6 +168,17 @@ class LocalCluster:
             return rproof.verify_range_proof_list(
                 lst, expected, sigs_pub_by_u, self.coll_tbl.table)
 
+        def vrange_joint(datas: list, survey_id: str) -> list:
+            survey = self.surveys.get(survey_id)
+            if survey is None:
+                return [False] * len(datas)
+            expected = self._ranges_per_value(survey.sq.query)
+            sigs_pub_by_u = {
+                u: [s.public for s in sigs]
+                for u, sigs in self.range_sigs.items()}
+            return rproof.verify_range_proof_payloads_joint(
+                datas, expected, sigs_pub_by_u, self.coll_tbl.table)
+
         def vagg(data: bytes, _sid: str) -> bool:
             from ..proofs.safe_pickle import safe_loads
 
@@ -195,7 +206,8 @@ class LocalCluster:
                 proof, jnp.asarray(in_cts), jnp.asarray(out_cts),
                 jnp.asarray(C.from_ref(self.coll_pub)))
 
-        return {"range": vrange, "aggregation": vagg, "obfuscation": vobf,
+        return {"range": vrange, "range_joint": vrange_joint,
+                "aggregation": vagg, "obfuscation": vobf,
                 "keyswitch": vks, "shuffle": vshuffle}
 
     # ------------------------------------------------------------------
@@ -212,6 +224,12 @@ class LocalCluster:
                               cutting_factor, lr_params)
         if group_by and op_name == "log_reg":
             raise ValueError("group_by is not supported for log_reg")
+        if group_by and cutting_factor > 1 and proofs:
+            # the replica-major dp_stats tiling and the group-major ranges
+            # tiling would interleave differently; nothing in the reference
+            # combines these either (CuttingFactor is a scale-test knob)
+            raise ValueError(
+                "cutting_factor > 1 with group_by and proofs is unsupported")
         if (op_name == "log_reg" and proofs and ranges
                 and len(set(map(tuple, ranges))) > 1):
             # the signed-encoding shift (run_survey) derives ONE offset from
@@ -224,6 +242,7 @@ class LocalCluster:
         q = Query(operation=op, ranges=ranges, proofs=proofs,
                   obfuscation=obfuscation,
                   diffp=diffp or DiffPParams(),
+                  cutting_factor=cutting_factor,
                   dp_data_min=query_min, dp_data_max=query_max,
                   sigs_present=proofs == 1 and ranges is not None
                   and not all(u == 0 and l == 0 for (u, l) in ranges),
@@ -259,11 +278,15 @@ class LocalCluster:
         return self.range_sigs[u]
 
     def prewarm_dro(self, noise_size: int, n_surveys: int = 1,
-                    seed: int = 0) -> None:
+                    seed: int = 0, cache_dir: Optional[str] = None) -> None:
         """Pre-fill the shuffle-precomputation pool: one fresh entry per
         (CN, survey). The reference does this at survey setup and persists
-        it (service.go:316-317 PrecomputationWritingForShuffling) so the
-        timed DRO phase only permutes + adds."""
+        it (service.go:316-317 PrecomputationWritingForShuffling /
+        pre_compute_multiplications.gob) so the timed DRO phase only
+        permutes + adds. With cache_dir set, each entry is ALSO written to
+        disk so a restarted process re-loads it (load_shuffle_precomp)
+        instead of re-paying the fixed-base mults; entries are consume-once
+        — the backing file is deleted when an entry is used."""
         pool = getattr(self, "_shuffle_precomp", None)
         if pool is None:
             pool = self._shuffle_precomp = {}
@@ -271,15 +294,107 @@ class LocalCluster:
         for ci in range(len(self.cns)):
             for _ in range(n_surveys):
                 key, k_pc = jax.random.split(key)
-                pool.setdefault((ci, noise_size), []).append(
-                    dro.precompute_rerandomization(
-                        k_pc, self.coll_tbl.table, noise_size))
+                pc = dro.precompute_rerandomization(
+                    k_pc, self.coll_tbl.table, noise_size)
+                path = None
+                if cache_dir is not None:
+                    import os
+
+                    os.makedirs(cache_dir, exist_ok=True)
+                    path = os.path.join(
+                        cache_dir, f"precomp_{ci}_{noise_size}_"
+                        f"{secrets.token_hex(6)}.npz")
+                    dro.save_precompute(path, pc)
+                pool.setdefault((ci, noise_size), []).append((pc, path))
+
+    def load_shuffle_precomp(self, cache_dir: str) -> int:
+        """Re-load persisted precomputation entries after a restart (the
+        reference reads its gob cache at service init, service.go:316-317).
+        Returns the number of entries loaded."""
+        import glob
+        import os
+
+        pool = getattr(self, "_shuffle_precomp", None)
+        if pool is None:
+            pool = self._shuffle_precomp = {}
+        n = 0
+        for path in sorted(glob.glob(os.path.join(cache_dir,
+                                                  "precomp_*.npz"))):
+            stem = os.path.basename(path)[len("precomp_"):-len(".npz")]
+            ci_s, size_s, _ = stem.split("_", 2)
+            pc = dro.load_precompute(path)
+            pool.setdefault((int(ci_s), int(size_s)), []).append((pc, path))
+            n += 1
+        return n
+
+    # ------------------------------------------------------------------
+    # Fused exec-path programs: the modular bucketed primitives cost one
+    # trace+lower each (~25-30 medium programs, ~12 min of host lowering
+    # per fresh process on this 1-core box — the round-2 bench timeouts).
+    # Fusing each phase into ONE jitted program mirrors flagship
+    # build_pipeline, which lowers+compiles in ~25 s.
+    # ------------------------------------------------------------------
+    def _fused(self):
+        fns = getattr(self, "_fused_fns", None)
+        if fns is not None:
+            return fns
+        import jax as _jax
+
+        from ..crypto import curve as Cv
+        from ..crypto import batching as Bt
+
+        base_tbl = eg.BASE_TABLE.table
+        coll_tbl = self.coll_tbl.table
+        q_tbl = self.client_tbl.table
+
+        @_jax.jit
+        def enc(stats, enc_rs):
+            m = eg.int_to_scalar(stats)
+            return eg.encrypt_with_tables(base_tbl, coll_tbl, m, enc_rs)
+
+        @_jax.jit
+        def agg_fn(cts):
+            return Bt.tree_reduce_add(cts, eg.ct_add)
+
+        @_jax.jit
+        def ks(agg, ks_rs, srv_x, offset_total):
+            # key switch: per-server contributions + reduce (commuting sum
+            # replaces the CN chain — parallel/collective.py derivation)
+            K0 = agg[:, 0]
+            u_pts = eg.fixed_base_mul(base_tbl, ks_rs)      # (ns, V, 3, 16)
+            rQ = eg.fixed_base_mul(q_tbl, ks_rs)
+            xK = Cv.scalar_mul(K0[None], srv_x[:, None, :])
+            w_pts = Cv.add(rQ, Cv.neg(xK))
+            k_sum = Bt.tree_reduce_add(u_pts, Cv.add)
+            c_sum = Bt.tree_reduce_add(w_pts, Cv.add)
+            c2 = Cv.add(agg[:, 1], c_sum)
+            # signed-offset correction; offset 0 gives 0*B = infinity which
+            # is the group identity, so the same program serves both cases
+            corr = eg.fixed_base_mul(
+                base_tbl, eg.int_to_scalar(offset_total[None]))
+            c2 = Cv.add(c2, Cv.neg(jnp.broadcast_to(corr[0], c2.shape)))
+            switched = jnp.stack([k_sum, c2], axis=-3)
+            return switched, u_pts, w_pts
+
+        @_jax.jit
+        def dec(switched, qx, keys, xs, ysign, vals):
+            pts = eg.decrypt_point(switched, qx)
+            dvals, found = eg._table_lookup(keys, xs, ysign, vals, pts)
+            zeros = Cv.is_infinity(pts)
+            return dvals, found, zeros
+
+        fns = self._fused_fns = (enc, agg_fn, ks, dec)
+        return fns
 
     @staticmethod
     def _ranges_per_value(q) -> list:
         """Per-OUTPUT-INDEX (u, l) specs: the query's per-V ranges, tiled
         across group-by groups (every group's value i shares spec i —
-        reference validates per-index ranges, lib/structs.go:446-533)."""
+        reference validates per-index ranges, lib/structs.go:446-533).
+        NOTE: q.ranges already spans the CuttingFactor replicas — the
+        query model multiplies nbr_output by cf (query.py choose_operation,
+        mirroring lib/structs.go:637-639) and check_parameters enforces
+        len(ranges) == nbr_output."""
         return list(q.ranges) * (q.n_groups() if q.group_by else 1)
 
     # ------------------------------------------------------------------
@@ -306,7 +421,8 @@ class LocalCluster:
                  "shuffle": sq.threshold,
                  "aggregation": sq.aggregation_proof_threshold,
                  "obfuscation": sq.obfuscation_proof_threshold,
-                 "keyswitch": sq.key_switching_proof_threshold})
+                 "keyswitch": sq.key_switching_proof_threshold},
+                expected_range=nbrs[0])
 
         # --- DP phase: encode + encrypt (+ range proofs) ----------------
         tm.start("DataCollectionProtocol")
@@ -318,6 +434,12 @@ class LocalCluster:
             # homomorphic addition the per-group aggregation (no same-group
             # matching; reference data_collection_protocol.go:157-168)
             dp_stats = dp_stats.reshape(dp_stats.shape[0], -1)
+        cf = max(int(q.cutting_factor), 1)
+        if cf > 1:
+            # CuttingFactor scale testing: replicate the output vector (and
+            # therefore every downstream ciphertext + proof) cf times
+            # (reference lib/structs.go:637-639)
+            dp_stats = np.tile(dp_stats, (1, cf))
         V = dp_stats.shape[1]
 
         # Sound range proofs for signed encodings: logreg fixed-point
@@ -337,28 +459,39 @@ class LocalCluster:
                 dp_stats = dp_stats + range_offset
         key, k_enc = jax.random.split(key)
         enc_rs = eg.random_scalars(k_enc, dp_stats.shape)
-        m = B.int_to_scalar(jnp.asarray(dp_stats))
-        cts = B.encrypt(eg.BASE_TABLE.table, self.coll_tbl.table,
-                        m, enc_rs)                          # (n_dps, V, 2,3,16)
+        f_enc, f_agg, f_ks, f_dec = self._fused()
+        cts = f_enc(jnp.asarray(dp_stats), enc_rs)          # (n_dps, V, 2,3,16)
+        cts.block_until_ready()
         tm.end("DataCollectionProtocol")
 
         if proofs_on:
             ranges_v = self._ranges_per_value(q)
             sigs_by_u = {u: self.ensure_range_sigs(u)
                          for (u, _l) in rproof.group_ranges(ranges_v)}
+            key, k_rp = jax.random.split(key)
+            # ONE device-batched creation for all DPs (their per-value
+            # transcripts are independent, so batching changes no proof);
+            # each DP's payload still ships + verifies separately
+            lists_box: dict = {}
+            lock = threading.Lock()
+
+            def dp_lists():
+                with lock:
+                    if "v" not in lists_box:
+                        lists_box["v"] = \
+                            rproof.create_range_proof_lists_batched(
+                                k_rp, dp_stats, enc_rs, cts, ranges_v,
+                                sigs_by_u, self.coll_tbl.table)
+                    return lists_box["v"]
+
             for i, dp in enumerate(self.dp_idents):
-                key, k_rp = jax.random.split(key)
                 self._async_proof(
                     survey, "range", dp,
-                    lambda i=i, k_rp=k_rp, ranges_v=ranges_v,
-                    sigs_by_u=sigs_by_u:
-                    rproof.create_range_proof_list(
-                        k_rp, dp_stats[i], enc_rs[i], cts[i], ranges_v,
-                        sigs_by_u, self.coll_tbl.table).to_bytes())
+                    lambda i=i: dp_lists()[i].to_bytes())
 
         # --- Aggregation phase (reference AggregationPhase :775) --------
         tm.start("AggregationPhase")
-        agg = B.tree_reduce_add(cts, B.ct_add)
+        agg = f_agg(cts)
         agg.block_until_ready()
         tm.end("AggregationPhase")
         if proofs_on:
@@ -413,8 +546,16 @@ class LocalCluster:
             for ci, cn in enumerate(self.cns):
                 key, k_sh = jax.random.split(key)
                 pc_key = (ci, int(n_cts.shape[0]))
-                pc = (pc_pool[pc_key].pop() if pc_pool.get(pc_key)
-                      else None)
+                pc = None
+                if pc_pool.get(pc_key):
+                    pc, pc_path = pc_pool[pc_key].pop()
+                    if pc_path is not None:
+                        import os
+
+                        try:  # consume-once: drop the persisted copy
+                            os.unlink(pc_path)
+                        except OSError:
+                            pass
                 if pc is None:
                     key, k_pc = jax.random.split(key)
                     pc = dro.precompute_rerandomization(
@@ -445,28 +586,13 @@ class LocalCluster:
         key, k_ks = jax.random.split(key)
         ks_rs = eg.random_scalars(k_ks, (len(self.cns), V))
         # per-server contributions, batched over (ns, V):
-        # U = r·B,  W = r·Q − x·K   (commuting; sum replaces the CN chain)
-        K0 = agg[:, 0]                                      # (V, 3, 16)
-        u_pts = B.fixed_base_mul(eg.BASE_TABLE.table, ks_rs)
-        rQ = B.fixed_base_mul(self.client_tbl.table, ks_rs)
-        xK = B.g1_scalar_mul(K0[None], srv_x[:, None, :])
-        w_pts = B.g1_add(rQ, B.g1_neg(xK))
-        k_sum, c_sum = u_pts[0], w_pts[0]
-        for i in range(1, len(self.cns)):
-            k_sum = B.g1_add(k_sum, u_pts[i])
-            c_sum = B.g1_add(c_sum, w_pts[i])
-        c2 = B.g1_add(agg[:, 1], c_sum)
-        if range_offset:
-            # subtract the public aggregate shift (n_dps * u^l/2) * B so the
-            # decrypted values are the true signed statistics
-            total = range_offset * len(self.dp_idents)
-            assert total < 2 ** 62, "offset too large for int64 scalar path"
-            corr = B.fixed_base_mul(
-                eg.BASE_TABLE.table,
-                B.int_to_scalar(jnp.asarray([total], dtype=jnp.int64)))
-            c2 = B.g1_add(c2, B.g1_neg(jnp.broadcast_to(
-                corr[0], c2.shape)))
-        switched = jnp.stack([k_sum, c2], axis=-3)
+        # U = r·B,  W = r·Q − x·K   (commuting; sum replaces the CN chain);
+        # the fused program also subtracts the public aggregate shift
+        # (n_dps * u^l/2)·B so decrypted values are true signed statistics
+        total = range_offset * len(self.dp_idents)
+        assert total < 2 ** 62, "offset too large for int64 scalar path"
+        switched, u_pts, w_pts = f_ks(
+            agg, ks_rs, srv_x, jnp.asarray(total, dtype=jnp.int64))
         switched.block_until_ready()
         tm.end("KeySwitchingPhase")
         if proofs_on:
@@ -481,15 +607,22 @@ class LocalCluster:
         # --- Querier decrypt + decode -----------------------------------
         tm.start("Decryption")
         xq = jnp.asarray(eg.secret_to_limbs(self.client.secret))
-        pts = B.decrypt_point(switched, xq)
         dl = self.dlog
-        vals, found = B.table_lookup(dl.keys, dl.xs, dl.ysign, dl.vals, pts)
-        zeros = B.is_infinity(pts)
+        vals, found, zeros = f_dec(switched, xq, dl.keys, dl.xs, dl.ysign,
+                                   dl.vals)
+        zeros.block_until_ready()
         tm.end("Decryption")
 
         dec = st.DecryptedVector(values=np.asarray(vals),
                                  found=np.asarray(found),
                                  is_zero=np.asarray(zeros))
+        if cf > 1:
+            # decode only the first replica (the rest are the scale-test
+            # padding; they decrypt to identical values)
+            v0 = V // cf
+            dec = st.DecryptedVector(values=dec.values[:v0],
+                                     found=dec.found[:v0],
+                                     is_zero=dec.is_zero[:v0])
         if op.name == "log_reg":
             tm.start("GradientDescent")
             Ts = lr.unpack(jnp.asarray(dec.values), op.lr_params)
@@ -539,13 +672,23 @@ class LocalCluster:
         lock = self._proof_device_lock
 
         def work():
-            with lock:
-                data = build()
-            req = rq.new_proof_request(
-                ptype, survey.sq.survey_id, ident.name,
-                f"{ptype}-{ident.name}", 0, data, ident.secret)
-            with lock:
-                self.vns.deliver(req)
+            try:
+                with lock:
+                    data = build()
+                req = rq.new_proof_request(
+                    ptype, survey.sq.survey_id, ident.name,
+                    f"{ptype}-{ident.name}", 0, data, ident.secret)
+                with lock:
+                    self.vns.deliver(req)
+            except BaseException:
+                # surface thread deaths LOUDLY — a dead proof thread means
+                # the VN counter never drains and the survey stalls at
+                # end_verification with zero evidence otherwise
+                import traceback
+
+                log.warn(f"proof thread {ptype}/{ident.name} DIED: "
+                         f"{traceback.format_exc()}")
+                raise
 
         t = threading.Thread(target=work, daemon=True)
         t.start()

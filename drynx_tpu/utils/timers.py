@@ -16,7 +16,13 @@ import time
 
 
 class PhaseTimers:
-    """Thread-safe named wall-clock timers accumulating per-phase seconds."""
+    """Thread-safe named wall-clock timers accumulating per-phase seconds.
+
+    With the class flag `echo` set, every completed phase prints to stderr
+    immediately — so a benchmark killed mid-run still shows where the time
+    went (round-2 driver timeouts erased all timing evidence)."""
+
+    echo = False
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -35,7 +41,12 @@ class PhaseTimers:
                 return 0.0
             dt = now - t0
             self._acc[name] = self._acc.get(name, 0.0) + dt
-            return dt
+        if PhaseTimers.echo:
+            import sys
+
+            print(f"    [phase] {name}: {dt:.3f}s", file=sys.stderr,
+                  flush=True)
+        return dt
 
     def __getitem__(self, name: str) -> float:
         return self._acc.get(name, 0.0)

@@ -1,0 +1,60 @@
+"""Eager-mode (no-jit) smoke test of the FULL proofs-on survey path.
+
+Validates semantics of the service pipeline — fused exec programs, batched
+DP proof creation, joint VN verification, Fiat-Shamir binding — without any
+XLA compiles (JAX_DISABLE_JIT): every kernel runs op-by-op on CPU. Takes a
+few minutes; used as the cheap pre-flight before burning a 90-minute TPU
+bench attempt on unvalidated code.
+
+Usage: python scripts/smoke_survey.py
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_DISABLE_JIT", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_max_isa" not in flags:
+    flags += " --xla_cpu_max_isa=AVX2"
+if "xla_backend_optimization_level" not in flags:
+    flags += " --xla_backend_optimization_level=0"
+os.environ["XLA_FLAGS"] = flags.strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_disable_jit", True)
+
+    from drynx_tpu.proofs import requests as rq
+    from drynx_tpu.service.service import LocalCluster
+
+    t0 = time.time()
+    cl = LocalCluster(n_cns=2, n_dps=2, n_vns=2, seed=23, dlog_limit=200)
+    per_dp = []
+    for dp in cl.dps.values():
+        d = np.asarray([1, 2], dtype=np.int64)
+        dp.data = d
+        per_dp.append(d)
+    sq = cl.generate_survey_query("sum", query_min=0, query_max=3, proofs=1,
+                                  ranges=[(2, 3)])  # sums < 8
+    print(f"[{time.time()-t0:6.1f}s] running proofs-on survey (eager)")
+    res = cl.run_survey(sq)
+    print(f"[{time.time()-t0:6.1f}s] survey done")
+    assert res.result == int(np.concatenate(per_dp).sum()), res.result
+    assert res.block is not None
+    codes = set(res.block.data.bitmap.values())
+    assert codes == {rq.BM_TRUE}, res.block.data.bitmap
+    assert cl.vns.root.chain.validate()
+
+    print(f"[{time.time()-t0:6.1f}s] smoke OK: clean bitmap, exact sum")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Mesh-sharded RLC range-proof verification (round-2 VERDICT weak #6 /
+task 6): the pairing-heavy batch check rides the virtual 8-device CPU mesh
+and must agree EXACTLY (bit-identical GT total) with the single-device path.
+"""
+import dataclasses as dc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from drynx_tpu.crypto import batching as B
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.crypto import fp12 as F12
+from drynx_tpu.crypto import params
+from drynx_tpu.parallel import proof_mesh as pm
+from drynx_tpu.proofs import range_proof as rp
+
+pytestmark = pytest.mark.slow  # pairing compiles; fast tier = -m 'not slow'
+
+RNG = np.random.default_rng(71)
+U, L, NS = 4, 2, 2          # values in [0, 16), 2 servers
+
+
+@pytest.fixture(scope="module")
+def setup():
+    sigs = [rp.init_range_sig(U, RNG) for _ in range(NS)]
+    _, ca_pub = eg.keygen(RNG)
+    ca_tbl = eg.pub_table(ca_pub)
+    values = np.asarray([3, 15, 0, 7], dtype=np.int64)
+    cts, rs = eg.encrypt_ints(jax.random.PRNGKey(72), ca_tbl, values)
+    proof = rp.create_range_proofs(
+        jax.random.PRNGKey(73), values, rs, cts, sigs, U, L, ca_tbl.table)
+    return sigs, ca_tbl, proof
+
+
+def _mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provide the 8-device CPU mesh"
+    return jax.sharding.Mesh(np.asarray(devs[:8]).reshape(4, 2),
+                             ("dp", "ct"))
+
+
+def test_sharded_total_matches_single_device(setup):
+    """Same verifier randomness => bit-identical GT total on the mesh."""
+    sigs, ca_tbl, proof = setup
+    pubs = [s.public for s in sigs]
+    pre_ok, r_int, gtb_pow_s = rp.rlc_prelude(
+        proof, pubs, ca_tbl.table, rng=np.random.default_rng(5))
+    assert pre_ok
+
+    total = pm.rlc_total_sharded(_mesh(), proof, pubs, r_int, gtb_pow_s)
+    # honest proof: the total IS the identity (this is also the
+    # single-device acceptance condition, so equality with it is implied)
+    assert bool(np.asarray(F12.eq(total, jnp.asarray(F12.one()))))
+
+    # and the full sharded verdict agrees with the host verifier
+    assert pm.rlc_verify_sharded(_mesh(), proof, pubs, ca_tbl.table,
+                                 rng=np.random.default_rng(6))
+    assert rp.verify_range_proofs_batch(proof, pubs, ca_tbl.table,
+                                        rng=np.random.default_rng(6))
+
+
+def test_sharded_verify_rejects_tampering(setup):
+    sigs, ca_tbl, proof = setup
+    pubs = [s.public for s in sigs]
+    bad_zv = np.asarray(proof.zv).copy()
+    bad_zv[0, 0, 0, 0] ^= 1
+    bad = dc.replace(proof, zv=jnp.asarray(bad_zv))
+    assert not pm.rlc_verify_sharded(_mesh(), bad, pubs, ca_tbl.table,
+                                     rng=np.random.default_rng(7))
+    # challenge binding also enforced on the sharded path
+    from drynx_tpu.crypto import field as F
+
+    bad2 = dc.replace(proof, a=F.neg(jnp.asarray(proof.a), F.FP))
+    assert not pm.rlc_verify_sharded(_mesh(), bad2, pubs, ca_tbl.table,
+                                     rng=np.random.default_rng(8))

@@ -134,3 +134,86 @@ def test_range_proof_rlc_batch_verify(setup):
     bad2 = dc.replace(proof, zv=jnp.asarray(bad_zv))
     assert not rp.verify_range_proofs_batch(bad2, pubs, ca_tbl.table,
                                             rng=np.random.default_rng(3))
+
+
+def _forge_proof(cts, c, zr, zphi, zv, v_pts, sigs_pub, ca_tbl, u, l):
+    """Build the derive-D-and-a forgery (round-2 VERDICT weak #2): with
+    c fixed FIRST and Zphi/Zr/Zv/V chosen freely, D and a are DERIVED from
+    the two verifier equations so both checks pass for a ciphertext
+    encrypting ANYTHING. Defeated only by the challenge binding."""
+    from drynx_tpu.crypto import batching as B
+    from drynx_tpu.crypto import curve as C
+
+    base_tbl = eg.BASE_TABLE.table
+    ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]))
+    C2 = jnp.asarray(cts)[..., 1, :, :]
+    wz = rp._weighted_sum_mod_n(zphi, rp._upow_mont(u, l))
+    D = B.g1_add(B.g1_scalar_mul(C2, c),
+                 B.g1_add(B.fixed_base_mul(ca_tbl.table, zr),
+                          B.fixed_base_mul(base_tbl, wz)))
+    cy = B.g1_scalar_mul(ys[:, None, :, :], c[None, :, :])
+    nzphiB = B.fixed_base_mul(base_tbl, B.fn_neg(zphi))
+    g1arg = B.g1_add(cy[:, :, None, :, :], nzphiB[None])
+    px, py, _ = B.g1_normalize(g1arg)
+    qx, qy, _ = B.g2_normalize(v_pts)
+    a = B.gt_mul(B.pair(px, py, qx, qy), rp.gt_pow_gtb(zv))
+    return rp.RangeProofBatch(commit=jnp.asarray(cts), challenge=c, zr=zr,
+                              d=D, zphi=zphi, zv=zv, v_pts=v_pts, a=a,
+                              u=u, l=l)
+
+
+def test_derived_commitment_forgery_rejected(setup):
+    """VERDICT round-2 weak #2 regression: a proof whose D and a are derived
+    from the verifier equations AFTER fixing c must be rejected — and it
+    MUST be the challenge binding that rejects it (the equation checks pass
+    by construction, demonstrating the attack is faithfully emulated)."""
+    sigs, _, _, ca_tbl = setup
+    pubs = [s.public for s in sigs]
+    # ciphertext encrypts 1000, far outside [0, u^l) = [0, 64)
+    out_of_range = np.asarray([1000], dtype=np.int64)
+    cts, _ = eg.encrypt_ints(jax.random.PRNGKey(41), ca_tbl, out_of_range)
+
+    # adversary: pick c and all responses freely (c BEFORE D/V/a)
+    c = eg.random_scalars(jax.random.PRNGKey(42), (1,))
+    zr = eg.random_scalars(jax.random.PRNGKey(43), (1,))
+    zphi = eg.random_scalars(jax.random.PRNGKey(44), (1, L))
+    zv = eg.random_scalars(jax.random.PRNGKey(45), (NS, 1, L))
+    # arbitrary valid G2 points for V: blinded copies of a digit signature
+    v_blind = eg.random_scalars(jax.random.PRNGKey(46), (NS, 1, L))
+    from drynx_tpu.crypto import batching as B
+    A_sel = jnp.asarray(np.stack([s.A for s in sigs]))[:, np.zeros((1, L),
+                                                                   np.int32)]
+    v_pts = B.g2_scalar_mul(A_sel, v_blind)
+
+    forged = _forge_proof(cts, c, zr, zphi, zv, v_pts, pubs, ca_tbl, U, L)
+
+    # equations alone accept the forgery (this is the round-2 hole) ...
+    eq_only = rp.verify_range_proofs(forged, pubs, ca_tbl.table,
+                                     check_challenge=False)
+    assert bool(np.all(eq_only)), "forgery construction broken: equations " \
+                                  "should hold by derivation"
+    # ... but the bound Fiat-Shamir challenge rejects it deterministically
+    assert not bool(np.any(rp.verify_range_proofs(forged, pubs,
+                                                  ca_tbl.table)))
+    assert not rp.verify_range_proofs_batch(forged, pubs, ca_tbl.table,
+                                            rng=np.random.default_rng(4))
+
+
+def test_rlc_small_order_forgery_rejected(setup):
+    """VERDICT round-2 weak #3 regression: a_ij := -a'_ij makes the RLC
+    factor -1, which passed the (challenge-unbound) batch verifier with
+    probability 1/2 per attempt. With a bound into the Fiat-Shamir hash the
+    rejection is deterministic — every seed must reject."""
+    import dataclasses as dc
+    sigs, _, _, ca_tbl = setup
+    pubs = [s.public for s in sigs]
+    values = np.asarray([5], dtype=np.int64)
+    cts, rs = eg.encrypt_ints(jax.random.PRNGKey(51), ca_tbl, values)
+    proof = rp.create_range_proofs(
+        jax.random.PRNGKey(52), values, rs, cts, sigs, U, L, ca_tbl.table)
+    neg_a = F.neg(jnp.asarray(proof.a), F.FP)   # -a: order-2 RLC factor
+    bad = dc.replace(proof, a=neg_a)
+    for seed in range(8):
+        assert not rp.verify_range_proofs_batch(
+            bad, pubs, ca_tbl.table, rng=np.random.default_rng(seed)), \
+            f"small-order forgery accepted with rng seed {seed}"
