@@ -208,6 +208,13 @@ def _build():
     g["ct_scalar_mul"] = bucketed(eg.ct_scalar_mul, (3, 1), 3)
     g["decrypt_point"] = bucketed(eg.decrypt_point, (3, 1), 2)
     g["is_infinity"] = bucketed(C.is_infinity, (2,), 0)
+    # Montgomery -> plain conversion for the canonical byte encoders
+    # (proofs/encoding.py): unbucketed they re-compile per raw tensor
+    # shape — the Fermat inverse in normalize is a 256-step scan
+    g["from_mont_p"] = bucketed(lambda x: F.from_mont(x, F.FP), (1,), 1,
+                                max_bucket=8192)
+    g["to_mont_p"] = bucketed(lambda x: F.to_mont(x, F.FP), (1,), 1,
+                              max_bucket=8192)
 
 
 def gt_reduce_prod(x):

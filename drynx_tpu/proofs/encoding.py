@@ -52,10 +52,16 @@ def scalar_bytes(s_limbs) -> np.ndarray:
 
 
 def g1_bytes(pts) -> np.ndarray:
-    """Jacobian Montgomery G1 (..., 3, 16) -> canonical (..., 64) uint8."""
-    x_m, y_m, inf = C.normalize(jnp.asarray(pts))
-    x = np.asarray(F.from_mont(x_m, FP))
-    y = np.asarray(F.from_mont(y_m, FP))
+    """Jacobian Montgomery G1 (..., 3, 16) -> canonical (..., 64) uint8.
+
+    Uses the BUCKETED normalize/from_mont kernels: the raw jnp path
+    re-traces the 256-step Fermat inverse for every distinct tensor shape
+    (challenges + serialization hit many shapes per survey)."""
+    from ..crypto import batching as B
+
+    x_m, y_m, inf = B.g1_normalize(jnp.asarray(pts))
+    x = np.asarray(B.from_mont_p(x_m))
+    y = np.asarray(B.from_mont_p(y_m))
     out = np.concatenate([limbs_to_bytes(x), limbs_to_bytes(y)], axis=-1)
     out[np.asarray(inf)] = 0
     return out
@@ -63,9 +69,13 @@ def g1_bytes(pts) -> np.ndarray:
 
 def g2_bytes(pts) -> np.ndarray:
     """Jacobian Montgomery G2 (..., 3, 2, 16) -> canonical (..., 128) uint8."""
-    x_m, y_m, inf = G2.normalize(jnp.asarray(pts))
-    parts = [np.asarray(F.from_mont(x_m[..., k, :], FP)) for k in range(2)]
-    parts += [np.asarray(F.from_mont(y_m[..., k, :], FP)) for k in range(2)]
+    from ..crypto import batching as B
+
+    x_m, y_m, inf = B.g2_normalize(jnp.asarray(pts))
+    plain = np.asarray(B.from_mont_p(
+        jnp.stack([x_m, y_m], axis=-3)))         # (..., 2, 2, 16)
+    parts = [plain[..., 0, 0, :], plain[..., 0, 1, :],
+             plain[..., 1, 0, :], plain[..., 1, 1, :]]
     out = np.concatenate([limbs_to_bytes(p) for p in parts], axis=-1)
     out[np.asarray(inf)] = 0
     return out
@@ -73,7 +83,9 @@ def g2_bytes(pts) -> np.ndarray:
 
 def gt_bytes(f) -> np.ndarray:
     """GT element (..., 6, 2, 16) Montgomery -> (..., 384) uint8."""
-    a = np.asarray(F.from_mont(jnp.asarray(f), FP))  # (..., 6, 2, 16)
+    from ..crypto import batching as B
+
+    a = np.asarray(B.from_mont_p(jnp.asarray(f)))  # (..., 6, 2, 16)
     b = limbs_to_bytes(a)  # (..., 6, 2, 32)
     return b.reshape(b.shape[:-3] + (6 * 2 * 2 * NUM_LIMBS,))
 

@@ -212,11 +212,13 @@ class RangeProofBatch:
 
 def _g1_from_bytes(b: np.ndarray) -> np.ndarray:
     """(..., 64) canonical bytes -> (..., 3, 16) Jacobian Montgomery."""
+    from ..crypto import batching as B
+
     x = enc.bytes_to_limbs(b[..., :32])
     y = enc.bytes_to_limbs(b[..., 32:])
     inf = np.all(b == 0, axis=-1)
-    xm = np.asarray(F.to_mont(jnp.asarray(x), FP))
-    ym = np.asarray(F.to_mont(jnp.asarray(y), FP))
+    xm = np.asarray(B.to_mont_p(jnp.asarray(x)))
+    ym = np.asarray(B.to_mont_p(jnp.asarray(y)))
     one = np.broadcast_to(np.asarray(FP.one_mont), xm.shape).copy()
     one[inf] = 0
     ym = ym.copy()
@@ -228,11 +230,13 @@ def _g1_from_bytes(b: np.ndarray) -> np.ndarray:
 
 def _g2_from_bytes(b: np.ndarray) -> np.ndarray:
     """(..., 128) -> (..., 3, 2, 16) Jacobian Montgomery."""
+    from ..crypto import batching as B
+
     comps = [enc.bytes_to_limbs(b[..., 32 * k:32 * (k + 1)]) for k in range(4)]
     inf = np.all(b == 0, axis=-1)
-    xm = np.stack([np.asarray(F.to_mont(jnp.asarray(c), FP))
+    xm = np.stack([np.asarray(B.to_mont_p(jnp.asarray(c)))
                    for c in comps[:2]], axis=-2)
-    ym = np.stack([np.asarray(F.to_mont(jnp.asarray(c), FP))
+    ym = np.stack([np.asarray(B.to_mont_p(jnp.asarray(c)))
                    for c in comps[2:]], axis=-2)
     zm = np.zeros_like(xm)
     zm[..., 0, :] = np.asarray(FP.one_mont)
@@ -248,8 +252,10 @@ def _g2_from_bytes(b: np.ndarray) -> np.ndarray:
 
 def _gt_from_bytes(b: np.ndarray) -> np.ndarray:
     """(..., 384) -> (..., 6, 2, 16) Montgomery."""
+    from ..crypto import batching as B
+
     limbs = enc.bytes_to_limbs(b.reshape(b.shape[:-1] + (12, 32)))
-    return np.asarray(F.to_mont(jnp.asarray(limbs), FP)).reshape(
+    return np.asarray(B.to_mont_p(jnp.asarray(limbs))).reshape(
         b.shape[:-1] + (6, 2, params.NUM_LIMBS))
 
 

@@ -1,8 +1,11 @@
 """Obfuscation / key-switch / aggregation proofs + Schnorr + request layer."""
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles crypto kernels; fast tier = -m "not slow"
+
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
 from drynx_tpu.crypto import curve as C
 from drynx_tpu.crypto import elgamal as eg

@@ -1,4 +1,8 @@
 """Simulation harness: grid rows run end-to-end and emit the phase CSV."""
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles crypto kernels; fast tier = -m "not slow"
+
 from drynx_tpu.simul import SimulationConfig, run_simulation
 from drynx_tpu.simul.runner import results_csv
 
