@@ -224,12 +224,18 @@ _G22_DEV = None
 
 
 def _twist_frob_consts():
+    # memoize HOST numpy arrays, NOT jnp: jnp.asarray inside a jit trace
+    # returns a tracer, and a tracer cached in a module global escapes its
+    # trace — the next caller dies with UnexpectedTracerError (hit when the
+    # first pairing of a process runs under a different bucket jit than the
+    # second; each call site re-wraps the constant into its own trace)
     global _G12_DEV, _G13_DEV, _G22_DEV
     if _G12_DEV is None:
-        _G12_DEV = jnp.asarray(F2.from_ref(refimpl._G12))
-        _G13_DEV = jnp.asarray(F2.from_ref(refimpl._G13))
-        _G22_DEV = jnp.asarray(F2.from_ref(refimpl._G22))
-    return _G12_DEV, _G13_DEV, _G22_DEV
+        _G12_DEV = np.asarray(F2.from_ref(refimpl._G12))
+        _G13_DEV = np.asarray(F2.from_ref(refimpl._G13))
+        _G22_DEV = np.asarray(F2.from_ref(refimpl._G22))
+    return (jnp.asarray(_G12_DEV), jnp.asarray(_G13_DEV),
+            jnp.asarray(_G22_DEV))
 
 
 def miller_loop(p_aff, q_aff):

@@ -267,11 +267,15 @@ _GT_B = None
 
 
 def gt_base():
-    """e(B, B2) — the pairing of both generators, device constant."""
+    """e(B, B2) — the pairing of both generators, device constant.
+
+    Memoized as HOST numpy (a jnp value cached from inside a jit trace
+    would be a leaked tracer — see pairing._twist_frob_consts)."""
     global _GT_B
     if _GT_B is None:
-        _GT_B = jnp.asarray(F12.from_ref(refimpl.pair(refimpl.G1, refimpl.G2)))
-    return _GT_B
+        _GT_B = np.asarray(F12.from_ref(refimpl.pair(refimpl.G1,
+                                                     refimpl.G2)))
+    return jnp.asarray(_GT_B)
 
 
 _GT_B_TABLE = None
@@ -296,8 +300,8 @@ def gt_base_table() -> jnp.ndarray:
                 T[w, j] = F12.from_ref(row)
             for _ in range(4):
                 cur = refimpl.fp12_mul(cur, cur)
-        _GT_B_TABLE = jnp.asarray(T)
-    return _GT_B_TABLE
+        _GT_B_TABLE = T  # host numpy; converted per use (tracer safety)
+    return jnp.asarray(_GT_B_TABLE)
 
 
 def gt_pow_gtb(k):
