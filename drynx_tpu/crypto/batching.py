@@ -99,6 +99,32 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
     return wrapped
 
 
+def host_dispatch(host_fn, tail_ranks, kernel_wrapped):
+    """Route a pairing-family op to the pure-Python oracle when Pallas is
+    unavailable (crypto/host_oracle.py — zero XLA compile, the round-3 CPU
+    compile bill was hours per process), else to the bucketed kernel. The
+    host path flattens/broadcasts all leading batch dims to one axis."""
+
+    def wrapped(*args):
+        from . import host_oracle as ho
+        from . import pallas_ops as po
+
+        if not (ho.ENABLED and not po.available()):
+            return kernel_wrapped(*args)
+        arrs = [np.asarray(a) for a in args]
+        batch = jnp.broadcast_shapes(
+            *[a.shape[: a.ndim - r] for a, r in zip(arrs, tail_ranks)])
+        flat = []
+        for a, r in zip(arrs, tail_ranks):
+            tail = a.shape[a.ndim - r:] if r else ()
+            flat.append(np.ascontiguousarray(
+                np.broadcast_to(a, batch + tail)).reshape((-1,) + tail))
+        out = host_fn(*flat)
+        return jnp.asarray(out.reshape(batch + out.shape[1:]))
+
+    return wrapped
+
+
 def tree_reduce_add(tensor, add_fn, axis: int = 0):
     """Log-depth reduction of `tensor` along `axis` with a batched group-add.
 
@@ -153,8 +179,11 @@ def _build():
 
     def _gt_pow_fn(f, k):
         if po.available():
-            # windowed kernel: ~2.4x over the square-and-multiply ladder
-            return ppair.f12_wpow_flat(f, k)
+            # windowed kernel with CYCLOTOMIC squarings (2x per squaring):
+            # every gt_pow call site feeds pairing outputs (sig_gt_table /
+            # gt_base), which live in GΦ12 by construction. Wire-provided
+            # GT elements go through gt_pow64 + gt_membership_ok instead.
+            return ppair.f12_wpow_flat(f, k, cyc=True)
         return F12.pow_var(f, k)
 
     def _gt_mul_fn(a, b):
@@ -170,28 +199,49 @@ def _build():
     def _gt_pow64_fn(f, k):
         # short exponents (RLC verification weights < 2^62): 21 windows;
         # n_bits=63 deliberately matches the final-exp u-chain pows so a
-        # shared (n_bits, wbits) jit entry can be reused at equal shapes
+        # shared (n_bits, wbits) jit entry can be reused at equal shapes.
+        # cyc=True: callers (RLC verify) gate wire GT elements through
+        # gt_membership_ok first, so cyclotomic squarings are valid.
         if po.available():
-            return ppair.f12_wpow_flat(f, k, n_bits=63)
+            return ppair.f12_wpow_flat(f, k, n_bits=63, cyc=True)
         return F12.pow_var(f, k)
+
+    def _gt_frob2_fn(f):
+        if po.available():
+            return ppair.f12_slotmul_flat(f, "frob2")
+        return PAIR._frob2(f)
 
     def _final_exp_fn(f):
         if po.available():
             return ppair.final_exp_flat(f)
         return PAIR.final_exp(f)
 
-    g["pair"] = bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32,
-                         max_bucket=2048)
-    g["miller"] = bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32,
-                           max_bucket=2048)
-    g["gt_pow"] = bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32,
-                           max_bucket=2048)
-    g["gt_pow64"] = bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32,
+    from . import host_oracle as ho
+
+    g["pair"] = host_dispatch(
+        ho.pair_host, (1, 1, 2, 2),
+        bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32, max_bucket=2048))
+    g["gt_frob2"] = bucketed(_gt_frob2_fn, (3,), 3, min_bucket=32,
                              max_bucket=2048)
-    g["final_exp"] = bucketed(_final_exp_fn, (3,), 3, min_bucket=8,
-                              max_bucket=2048)
-    g["gt_mul"] = bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32,
-                           max_bucket=2048)
+    g["g1_scalar_mul64"] = bucketed(
+        lambda p, k: C.scalar_mul_short(p, k, 64), (2, 1), 2,
+        max_bucket=4096)
+    g["miller"] = host_dispatch(
+        ho.miller_host, (1, 1, 2, 2),
+        bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32,
+                 max_bucket=2048))
+    g["gt_pow"] = host_dispatch(
+        ho.gt_pow_host, (3, 1),
+        bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32, max_bucket=2048))
+    g["gt_pow64"] = host_dispatch(
+        ho.gt_pow_host, (3, 1),
+        bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32, max_bucket=2048))
+    g["final_exp"] = host_dispatch(
+        ho.final_exp_host, (3,),
+        bucketed(_final_exp_fn, (3,), 3, min_bucket=8, max_bucket=2048))
+    g["gt_mul"] = host_dispatch(
+        ho.gt_mul_host, (3, 3),
+        bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32, max_bucket=2048))
     g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32, max_bucket=2048)
     g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1)
     g["fn_sub"] = bucketed(lambda a, b: F.sub(a, b, FN), (1, 1), 1)
@@ -215,6 +265,25 @@ def _build():
                                 max_bucket=8192)
     g["to_mont_p"] = bucketed(lambda x: F.to_mont(x, F.FP), (1,), 1,
                               max_bucket=8192)
+
+
+def gt_membership_ok(a) -> bool:
+    """True iff EVERY element of `a` (..., 6, 2, 16) lies in GΦ12(p):
+    z^(p^4)·z == z^(p^2)  ⇔  z^(p^4 - p^2 + 1) = 1.
+
+    Honest GT elements (pairing outputs after the final exponentiation) are
+    always members. The check gates WIRE-provided GT elements before any
+    cyclotomic-squaring pow chain runs on them — outside GΦ12 the
+    Granger-Scott formulas compute an unrelated function, so a forger must
+    not reach them. Cost: two Frobenius maps + one mul + one compare over
+    the batch (a handful of constant Fp2 muls per element)."""
+    from . import params
+
+    flat = jnp.asarray(a).reshape(-1, 6, 2, params.NUM_LIMBS)
+    z2 = gt_frob2(flat)
+    z4 = gt_frob2(z2)
+    lhs = gt_mul(z4, flat)
+    return bool(np.all(np.asarray(gt_eq(lhs, z2))))
 
 
 def gt_reduce_prod(x):
@@ -245,11 +314,12 @@ def gt_reduce_prod(x):
 
 _build()
 
-__all__ = ["bucketed", "tree_reduce_add", "gt_reduce_prod", "g1_add",
-           "g1_neg", "g1_scalar_mul", "g1_eq",
+__all__ = ["bucketed", "tree_reduce_add", "gt_reduce_prod",
+           "gt_membership_ok", "g1_add",
+           "g1_neg", "g1_scalar_mul", "g1_scalar_mul64", "g1_eq",
            "g1_normalize", "g2_scalar_mul", "g2_normalize", "fixed_base_mul",
-           "pair", "miller", "gt_pow", "gt_pow64", "final_exp", "gt_mul",
-           "gt_eq", "fn_add", "fn_sub", "fn_neg",
+           "pair", "miller", "gt_pow", "gt_pow64", "gt_frob2", "final_exp",
+           "gt_mul", "gt_eq", "fn_add", "fn_sub", "fn_neg",
            "fn_mul_plain", "fn_mont_mul", "encrypt", "int_to_scalar",
            "table_lookup", "ct_add", "ct_scalar_mul", "decrypt_point",
            "is_infinity"]

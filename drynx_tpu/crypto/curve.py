@@ -174,6 +174,47 @@ def scalar_mul(p, k_limbs):
     return _scalar_mul_jnp(p, k_limbs)
 
 
+def scalar_mul_short(p, k_limbs, n_bits: int = 64):
+    """k * P for SHORT scalars (k < 2^n_bits, e.g. the 62-bit RLC
+    verification weights): the Pallas ladder runs ceil(n_bits/4) windows
+    instead of 64 — 4x fewer ladder steps at n_bits=64. Semantics equal
+    scalar_mul for in-range k; out-of-range high bits are simply ignored."""
+    from . import pallas_ops as po
+
+    if po.available():
+        batch = jnp.broadcast_shapes(p.shape[:-2], k_limbs.shape[:-1])
+        pb = jnp.broadcast_to(p, batch + (3, NUM_LIMBS))
+        kb = jnp.broadcast_to(k_limbs, batch + (NUM_LIMBS,))
+        out = po.scalar_mul_flat(pb.reshape((-1, 3, NUM_LIMBS)),
+                                 kb.reshape((-1, NUM_LIMBS)),
+                                 n_windows=(n_bits + 3) // 4)
+        return out.reshape(batch + (3, NUM_LIMBS))
+    return _scalar_mul_jnp_short(p, k_limbs, n_bits)
+
+
+@partial(jax.jit, static_argnames="n_bits")
+def _scalar_mul_jnp_short(p, k_limbs, n_bits: int):
+    """Truncated fallback ladder: scan only the low n_bits (LSB-first)."""
+    bits = (k_limbs[..., :, None]
+            >> jnp.arange(params.LIMB_BITS, dtype=jnp.uint32)) & 1
+    bits = bits.reshape(bits.shape[:-2] + (256,))[..., :n_bits]
+    bits_t = jnp.moveaxis(bits, -1, 0)
+
+    batch = jnp.broadcast_shapes(p.shape[:-2], k_limbs.shape[:-1])
+    acc0 = infinity(batch)
+    base0 = jnp.broadcast_to(p, batch + (3, NUM_LIMBS))
+
+    def step(state, bit):
+        acc, base = state
+        acc2 = add(acc, base)
+        acc = jnp.where(bit[..., None, None] == 1, acc2, acc)
+        base = double(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (acc0, base0), bits_t)
+    return acc
+
+
 @jax.jit
 def _scalar_mul_jnp(p, k_limbs):
     """Fallback ladder: 256-step double-and-add-always scan (constant shape,
@@ -247,6 +288,7 @@ def scalars_from_ints(ks) -> np.ndarray:
 
 __all__ = [
     "from_ref", "from_ref_batch", "to_ref", "infinity", "G1_GEN",
-    "is_infinity", "double", "add", "neg", "scalar_mul", "normalize", "eq",
+    "is_infinity", "double", "add", "neg", "scalar_mul", "scalar_mul_short",
+    "normalize", "eq",
     "scalars_from_ints",
 ]

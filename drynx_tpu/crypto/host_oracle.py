@@ -1,0 +1,213 @@
+"""Host-oracle fallbacks for the pairing family where Pallas is absent.
+
+Why this exists: on CPU the jnp pairing graphs (65-step Miller scan, the
+final-exp pow chains) cost HOURS of XLA compile per process — the round-3
+compile bill that timed out benches, left the RLC soundness test unvalidated
+for a whole round, and blocked the scaling-grid capture. The pure-Python
+oracle (crypto/refimpl.py — the implementation every kernel is validated
+against) runs the same math at ~0.1 s per pairing with ZERO compile, which
+is faster than the compiled path for every one-shot process we run on CPU
+(tests, simulation grid rows).
+
+Exactness: full reduced pairings are implementation-independent. Miller
+values differ between implementations by Fp-subfield line factors, which the
+final exponentiation kills — and every consumer of bare Miller values here
+multiplies them only under a later final_exp (the RLC verifier's shared
+final exp), so mixing is safe. GT pows/muls are plain field math.
+
+The TPU path (crypto/pallas_pairing.py) is untouched; kill-switch:
+DRYNX_CPU_ORACLE_PAIR=0 restores the jnp fallbacks (compile-heavy).
+
+Layouts mirror crypto/batching.py: Fp limbs are (…, 16) uint32 Montgomery;
+G2/Fp2 coords (…, 2, 16); GT (…, 6, 2, 16); exponents (…, 16) PLAIN limbs.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import params, refimpl
+
+P = params.P
+_RINV = pow(params.R, P - 2, P)
+
+ENABLED = os.environ.get("DRYNX_CPU_ORACLE_PAIR", "1") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Limb <-> int conversion (host)
+# ---------------------------------------------------------------------------
+
+def _limbs_to_int(limbs) -> int:
+    v = 0
+    for i, w in enumerate(np.asarray(limbs, dtype=np.uint64)):
+        v |= int(w) << (params.LIMB_BITS * i)
+    return v
+
+
+def _mont_to_int(limbs) -> int:
+    return _limbs_to_int(limbs) * _RINV % P
+
+
+def _int_to_mont(v: int) -> np.ndarray:
+    return np.asarray(params.to_limbs(v * params.R % P), dtype=np.uint32)
+
+
+def _fp2_to_int(x):          # (2, 16) Montgomery -> (int, int)
+    return (_mont_to_int(x[0]), _mont_to_int(x[1]))
+
+
+def _fp12_to_ref(f):         # (6, 2, 16) Montgomery -> ref tuple
+    return tuple(_fp2_to_int(f[k]) for k in range(6))
+
+
+def _fp12_from_ref(f) -> np.ndarray:   # ref tuple -> (6, 2, 16) Montgomery
+    out = np.empty((6, 2, params.NUM_LIMBS), dtype=np.uint32)
+    for k, (c0, c1) in enumerate(f):
+        out[k, 0] = _int_to_mont(c0)
+        out[k, 1] = _int_to_mont(c1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fast final exponentiation (easy part + Olivos/DSD hard part on ints).
+# refimpl.final_exp is the naive f^((p^12-1)/n) (~4500 squarings, 0.6 s);
+# this is the same chain pairing.py/_hard_part runs on device (~45 ms).
+# Parity vs the naive one is asserted in tests/test_pairing.py.
+# ---------------------------------------------------------------------------
+
+_FROBC: dict = {}
+
+
+def _frob_consts(e: int):
+    if e not in _FROBC:
+        g = refimpl.fp2_pow(params.XI, (P ** e - 1) // 6)
+        consts, cur = [], (1, 0)
+        for _k in range(6):
+            consts.append(cur)
+            cur = refimpl.fp2_mul(cur, g)
+        _FROBC[e] = consts
+    return _FROBC[e]
+
+
+def _fp12_frob(f, e: int):
+    """f^(p^e) on the flat tower (e in {1, 2, 3}); odd e conjugates the
+    Fp2 coefficients (p = 3 mod 4) — same math as pairing._frob1/2/3."""
+    consts = _frob_consts(e)
+    conj = e % 2 == 1
+    out = []
+    for k in range(6):
+        c = f[k]
+        if conj:
+            c = (c[0], (-c[1]) % P)
+        out.append(refimpl.fp2_mul(c, consts[k]))
+    return tuple(out)
+
+
+def final_exp_fast(f):
+    """refimpl-exact final exponentiation via easy part + DSD hard part."""
+    mul, conj = refimpl.fp12_mul, refimpl.fp12_conj6
+    f1 = mul(conj(f), refimpl.fp12_inv(f))
+    f2 = mul(_fp12_frob(f1, 2), f1)
+
+    u = params.U
+    fx = refimpl.fp12_pow(f2, u)
+    fx2 = refimpl.fp12_pow(fx, u)
+    fx3 = refimpl.fp12_pow(fx2, u)
+
+    y0 = mul(mul(_fp12_frob(f2, 1), _fp12_frob(f2, 2)), _fp12_frob(f2, 3))
+    y1 = conj(f2)
+    y2 = _fp12_frob(fx2, 2)
+    y3 = conj(_fp12_frob(fx, 1))
+    y4 = conj(mul(fx, _fp12_frob(fx2, 1)))
+    y5 = conj(fx2)
+    y6 = conj(mul(fx3, _fp12_frob(fx3, 1)))
+
+    sqr = refimpl.fp12_sq
+    t0 = mul(mul(sqr(y6), y4), y5)
+    t1 = mul(mul(y3, y5), t0)
+    t0 = mul(t0, y2)
+    t1 = mul(sqr(t1), t0)
+    t1 = sqr(t1)
+    t0b = mul(t1, y1)
+    t1 = mul(t1, y0)
+    t0b = sqr(t0b)
+    return mul(t0b, t1)
+
+
+# ---------------------------------------------------------------------------
+# Batched host ops (loop over N; each element is oracle math)
+# ---------------------------------------------------------------------------
+
+def _g1_aff(px, py, i):
+    x, y = _mont_to_int(px[i]), _mont_to_int(py[i])
+    return None if x == 0 and y == 0 else (x, y)
+
+
+def _g2_aff(qx, qy, i):
+    x, y = _fp2_to_int(qx[i]), _fp2_to_int(qy[i])
+    return None if x == (0, 0) and y == (0, 0) else (x, y)
+
+
+def pair_host(px, py, qx, qy) -> np.ndarray:
+    """Full reduced pairing: affine Montgomery inputs -> (N, 6, 2, 16)."""
+    px, py = np.asarray(px), np.asarray(py)
+    qx, qy = np.asarray(qx), np.asarray(qy)
+    N = px.shape[0]
+    out = np.empty((N, 6, 2, params.NUM_LIMBS), dtype=np.uint32)
+    for i in range(N):
+        p, q = _g1_aff(px, py, i), _g2_aff(qx, qy, i)
+        if p is None or q is None:
+            out[i] = _fp12_from_ref(refimpl.FP12_ONE)
+        else:
+            out[i] = _fp12_from_ref(
+                final_exp_fast(refimpl.ate_miller_loop(p, q)))
+    return out
+
+
+def miller_host(px, py, qx, qy) -> np.ndarray:
+    """Unreduced ate Miller values (consumed only under a later final exp)."""
+    px, py = np.asarray(px), np.asarray(py)
+    qx, qy = np.asarray(qx), np.asarray(qy)
+    N = px.shape[0]
+    out = np.empty((N, 6, 2, params.NUM_LIMBS), dtype=np.uint32)
+    for i in range(N):
+        p, q = _g1_aff(px, py, i), _g2_aff(qx, qy, i)
+        if p is None or q is None:
+            out[i] = _fp12_from_ref(refimpl.FP12_ONE)
+        else:
+            out[i] = _fp12_from_ref(refimpl.ate_miller_loop(p, q))
+    return out
+
+
+def final_exp_host(f) -> np.ndarray:
+    f = np.asarray(f)
+    out = np.empty_like(f)
+    for i in range(f.shape[0]):
+        out[i] = _fp12_from_ref(final_exp_fast(_fp12_to_ref(f[i])))
+    return out
+
+
+def gt_pow_host(f, k) -> np.ndarray:
+    """f^k elementwise: f (N, 6, 2, 16) Montgomery, k (N, 16) plain limbs."""
+    f, k = np.asarray(f), np.asarray(k)
+    out = np.empty_like(f)
+    for i in range(f.shape[0]):
+        out[i] = _fp12_from_ref(refimpl.fp12_pow(
+            _fp12_to_ref(f[i]), _limbs_to_int(k[i])))
+    return out
+
+
+def gt_mul_host(a, b) -> np.ndarray:
+    """Elementwise product: both (N, 6, 2, 16) Montgomery."""
+    a, b = np.asarray(a), np.asarray(b)
+    out = np.empty_like(a)
+    for i in range(a.shape[0]):
+        out[i] = _fp12_from_ref(refimpl.fp12_mul(_fp12_to_ref(a[i]),
+                                                 _fp12_to_ref(b[i])))
+    return out
+
+
+__all__ = ["ENABLED", "pair_host", "miller_host", "final_exp_host",
+           "gt_pow_host", "gt_mul_host", "final_exp_fast"]

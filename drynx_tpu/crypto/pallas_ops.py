@@ -220,7 +220,8 @@ def _inf_like(p):
 # Windowed scalar-mult kernel: whole ladder in one pallas_call
 # ---------------------------------------------------------------------------
 
-def _scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref):
+def _scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref,
+                       *, n_windows: int = 64):
     m = m_ref[:]                              # (16, 1) modulus limbs
     nprime = np_ref[0, 0]
     pdouble, padd = make_group(m, nprime)
@@ -238,14 +239,16 @@ def _scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref):
     tabY = jnp.stack([t[1] for t in tab])
     tabZ = jnp.stack([t[2] for t in tab])
 
-    # 64 4-bit digits, MSB-first rows: digits[w] = digit 63-w, staged in a
-    # VMEM scratch so the loop body can dynamic-slice them (register arrays
-    # cannot be dynamically indexed in Mosaic)
+    # n_windows 4-bit digits, MSB-first rows, staged in a VMEM scratch so
+    # the loop body can dynamic-slice them (register arrays cannot be
+    # dynamically indexed in Mosaic). n_windows < 64 serves scalars known
+    # to be < 16^n_windows (e.g. 62-bit RLC weights: 16 windows, 4x fewer
+    # ladder steps than the generic 256-bit path).
     rows = []
-    for w in range(63, -1, -1):
+    for w in range(n_windows - 1, -1, -1):
         limb, s = divmod(w, 4)
         rows.append((k[limb] >> np.uint32(4 * s)) & np.uint32(0xF))
-    dig_ref[:] = jnp.stack(rows)              # (64, B) MSB first
+    dig_ref[:] = jnp.stack(rows)              # (n_windows, B) MSB first
 
     def select(d):
         # per-lane table lookup via 16 selects (constant-time)
@@ -266,16 +269,17 @@ def _scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref):
 
     # int32 bounds: with jax_enable_x64 a python-int fori_loop carries an
     # i64 induction var, which Mosaic cannot lower
-    acc = jax.lax.fori_loop(jnp.int32(1), jnp.int32(64), body, acc0)
+    acc = jax.lax.fori_loop(jnp.int32(1), jnp.int32(n_windows), body, acc0)
     o_ref[0] = acc[0]
     o_ref[1] = acc[1]
     o_ref[2] = acc[2]
 
 
-@jax.jit
-def scalar_mul_flat(p, k):
+@functools.partial(jax.jit, static_argnames="n_windows")
+def scalar_mul_flat(p, k, n_windows: int = 64):
     """k*P batched: p (N, 3, 16) Jacobian Montgomery, k (N, 16) plain
-    scalars -> (N, 3, 16). Pads N up to a LANES multiple and tiles."""
+    scalars -> (N, 3, 16). Pads N up to a LANES multiple and tiles.
+    n_windows < 64 truncates the ladder for short scalars (k < 16^W)."""
     N = p.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -287,13 +291,14 @@ def scalar_mul_flat(p, k):
     # x64 mode would make BlockSpec index maps / loop bounds i64, which
     # Mosaic cannot legalize; every value here is uint32, so drop to x32
     with jax.enable_x64(False):
-        out = _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np)
+        out = _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np,
+                                 n_windows)
     return jnp.transpose(out, (2, 0, 1))[:N]
 
 
-def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np):
+def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np, n_windows=64):
     return pl.pallas_call(
-        _scalar_mul_kernel,
+        functools.partial(_scalar_mul_kernel, n_windows=n_windows),
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((NL, 1), lambda i: (0, 0),
@@ -308,7 +313,7 @@ def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np):
         out_specs=pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((3, NL, Np), jnp.uint32),
-        scratch_shapes=[pltpu.VMEM((64, LANES), jnp.uint32)],
+        scratch_shapes=[pltpu.VMEM((n_windows, LANES), jnp.uint32)],
         interpret=INTERPRET,
     )(m_in, np_in, pt, kt)
 

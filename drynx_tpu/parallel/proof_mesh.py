@@ -79,7 +79,7 @@ def rlc_total_sharded(mesh, proof, sigs_pub, r_int, gtb_pow_s):
     cy = B.g1_scalar_mul(ys[:, None, :, :], c[None, :, :])
     nzphiB = B.fixed_base_mul(eg.BASE_TABLE.table, B.fn_neg(zphi))
     g1arg = B.g1_add(cy[:, :, None, :, :], nzphiB[None])       # (ns, V, l, 3, 16)
-    g1arg_r = B.g1_scalar_mul(g1arg, r)
+    g1arg_r = B.g1_scalar_mul64(g1arg, r)   # 62-bit weights: short ladder
     px, py, _ = B.g1_normalize(g1arg_r)
     qx, qy, _ = B.g2_normalize(jnp.asarray(proof.v_pts))
     conj_a = F12.conj6(jnp.asarray(proof.a))
@@ -97,8 +97,9 @@ def rlc_total_sharded(mesh, proof, sigs_pub, r_int, gtb_pow_s):
         m = PAIR.miller_loop((px, py), (qx, qy))
         if po.available():
             # 63-bit windowed pow — same kernel the single-device verifier
-            # uses for the 62-bit RLC weights (batching.gt_pow64)
-            ar = ppair.f12_wpow_flat(ca, rr, n_bits=63)
+            # uses for the 62-bit RLC weights (batching.gt_pow64); cyc is
+            # safe: rlc_prelude gated a through gt_membership_ok
+            ar = ppair.f12_wpow_flat(ca, rr, n_bits=63, cyc=True)
         else:
             ar = F12.pow_var(ca, rr)
         one = jnp.broadcast_to(jnp.asarray(F12.one()), m.shape)

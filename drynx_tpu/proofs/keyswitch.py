@@ -31,6 +31,9 @@ from ..crypto.field import FN
 from . import encoding as enc
 
 
+from typing import Optional
+
+
 @dataclasses.dataclass
 class KeySwitchProofBatch:
     """(ns, V) key-switch contribution proofs."""
@@ -46,28 +49,46 @@ class KeySwitchProofBatch:
     challenge: jnp.ndarray  # (ns, V, 16)
     zr: jnp.ndarray       # (ns, V, 16)
     zx: jnp.ndarray       # (ns, V, 16)
+    # canonical-byte cache of every hashed tensor (same contract as
+    # RangeProofBatch.wire: MUST match the tensors when set — creation
+    # fills it; code building modified batches must pass wire=None).
+    # Saves the verifier the 8 normalize+from_mont device passes of the
+    # challenge recompute (pure host hashing instead).
+    wire: Optional[dict] = None
+
+    def wire_bytes(self) -> dict:
+        if self.wire is None:
+            self.wire = _wire_dict(self)
+        return self.wire
 
     def to_bytes(self) -> bytes:
         ns, V = int(self.u_pts.shape[0]), int(self.u_pts.shape[1])
-        head = np.asarray([ns, V], dtype=np.int64).tobytes()
-        parts = [enc.g1_bytes(self.orig_k), enc.g1_bytes(self.u_pts),
-                 enc.g1_bytes(self.w_pts), enc.g1_bytes(self.ys),
-                 enc.g1_bytes(self.q_pt), enc.g1_bytes(self.a1),
-                 enc.g1_bytes(self.a2), enc.g1_bytes(self.a3),
+        head = np.asarray([ns, V], dtype="<i8").tobytes()
+        w = self.wire_bytes()
+        parts = [w["k"], w["u"], w["w"], w["ys"], w["q"], w["a1"], w["a2"],
+                 w["a3"],
                  enc.scalar_bytes(self.challenge), enc.scalar_bytes(self.zr),
                  enc.scalar_bytes(self.zx)]
         return head + b"".join(np.ascontiguousarray(p).tobytes()
                                for p in parts)
 
 
-def _challenge(orig_k, u_pts, w_pts, ys, q_pt, a1, a2, a3) -> jnp.ndarray:
-    ns, V = u_pts.shape[0], u_pts.shape[1]
-    kb = np.broadcast_to(enc.g1_bytes(orig_k), (ns, V, 64))
-    yb = np.broadcast_to(enc.g1_bytes(ys)[:, None, :], (ns, V, 64))
-    qb = np.broadcast_to(enc.g1_bytes(q_pt), (ns, V, 64))
+def _wire_dict(pb: "KeySwitchProofBatch") -> dict:
+    """THE one definition of the canonical transcript encoding — creation,
+    wire_bytes and verification all call this so the Fiat-Shamir hash can
+    never desynchronize between them."""
+    return {"k": enc.g1_bytes(pb.orig_k), "u": enc.g1_bytes(pb.u_pts),
+            "w": enc.g1_bytes(pb.w_pts), "ys": enc.g1_bytes(pb.ys),
+            "q": enc.g1_bytes(pb.q_pt), "a1": enc.g1_bytes(pb.a1),
+            "a2": enc.g1_bytes(pb.a2), "a3": enc.g1_bytes(pb.a3)}
+
+
+def _challenge_from_wire(w: dict, ns: int, V: int) -> jnp.ndarray:
+    kb = np.broadcast_to(w["k"], (ns, V, 64))
+    yb = np.broadcast_to(w["ys"][:, None, :], (ns, V, 64))
+    qb = np.broadcast_to(w["q"], (ns, V, 64))
     return jnp.asarray(enc.hash_to_scalar(
-        kb, enc.g1_bytes(u_pts), enc.g1_bytes(w_pts), yb, qb,
-        enc.g1_bytes(a1), enc.g1_bytes(a2), enc.g1_bytes(a3),
+        kb, w["u"], w["w"], yb, qb, w["a1"], w["a2"], w["a3"],
         batch_shape=(ns, V)))
 
 
@@ -102,12 +123,21 @@ def create_keyswitch_proofs(key, orig_k, srv_x, ks_rs, q_pt, q_tbl,
     a1, a2, a3 = _commit_kernel(orig_k, q_tbl, wr, wx)
     base = eg.BASE_TABLE.table
     ys = eg.fixed_base_mul(base, jnp.asarray(srv_x))
-    c = _challenge(orig_k, u_pts, w_pts, ys, q_pt, a1, a2, a3)
+    # build the batch FIRST, then hash via the shared _wire_dict; the wire
+    # cache is deliberately NOT retained on the returned object — the
+    # payload travels as pickle and the dead bytes would bloat every
+    # prover->VN message and ProofDB entry (the verifier re-encodes anyway)
+    pb = KeySwitchProofBatch(orig_k=jnp.asarray(orig_k), u_pts=u_pts,
+                             w_pts=w_pts, ys=ys, q_pt=jnp.asarray(q_pt),
+                             a1=a1, a2=a2, a3=a3,
+                             challenge=jnp.zeros((ns, V, 16), jnp.uint32),
+                             zr=jnp.zeros((ns, V, 16), jnp.uint32),
+                             zx=jnp.zeros((ns, V, 16), jnp.uint32))
+    c = _challenge_from_wire(_wire_dict(pb), ns, V)
     zr, zx = _response_kernel(wr, wx, c, jnp.asarray(ks_rs),
                               jnp.asarray(srv_x)[:, None, :])
-    return KeySwitchProofBatch(orig_k=jnp.asarray(orig_k), u_pts=u_pts,
-                               w_pts=w_pts, ys=ys, q_pt=jnp.asarray(q_pt),
-                               a1=a1, a2=a2, a3=a3, challenge=c, zr=zr, zx=zx)
+    pb.challenge, pb.zr, pb.zx = c, zr, zx
+    return pb
 
 
 @jax.jit
@@ -124,13 +154,19 @@ def _verify_kernel(orig_k, u_pts, w_pts, ys, q_tbl, a1, a2, a3, c, zr, zx):
 
 
 def verify_keyswitch_proofs(proof: KeySwitchProofBatch, q_tbl) -> np.ndarray:
-    """Returns bool (ns, V); recomputes the challenge."""
+    """Returns bool (ns, V); recomputes the challenge.
+
+    Deliberately IGNORES any attached wire-byte cache: this batch travels
+    as a pickle, so a malicious sender could ship a cache that disagrees
+    with the tensors — hashing it would let them fix c first and derive
+    a1/a2/a3 post-hoc. The verifier re-encodes the tensors it actually
+    checks. (RangeProofBatch CAN trust its cache: from_bytes derives
+    tensors and cache from one buffer.)"""
     ok = np.asarray(_verify_kernel(
         proof.orig_k, proof.u_pts, proof.w_pts, proof.ys, q_tbl, proof.a1,
         proof.a2, proof.a3, proof.challenge, proof.zr, proof.zx))
-    want = np.asarray(_challenge(proof.orig_k, proof.u_pts, proof.w_pts,
-                                 proof.ys, proof.q_pt, proof.a1, proof.a2,
-                                 proof.a3))
+    ns, V = int(proof.u_pts.shape[0]), int(proof.u_pts.shape[1])
+    want = np.asarray(_challenge_from_wire(_wire_dict(proof), ns, V))
     return ok & np.all(np.asarray(proof.challenge) == want, axis=-1)
 
 

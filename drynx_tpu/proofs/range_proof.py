@@ -162,6 +162,22 @@ class RangeProofBatch:
     a: jnp.ndarray           # (ns, V, l, 6, 2, 16)
     u: int
     l: int
+    # canonical-byte cache for the Fiat-Shamir transcript + serialization:
+    # {'commit': (V,128), 'd': (V,64), 'v': (ns,V,l,128), 'a': (ns,V,l,384)}
+    # uint8 numpy. Filled at creation (the bytes ARE the wire format) and at
+    # from_bytes (the received wire bytes) so neither side pays a second
+    # normalize/from_mont device pass to re-derive them. Hashing the wire
+    # bytes is the standard FS practice (bind the message as transmitted):
+    # decode(bytes) -> point is deterministic, so binding the bytes binds
+    # the commitments at least as strongly as re-encoding would.
+    # INVARIANT: when set, `wire` MUST be the canonical encoding of the
+    # tensors above. create_range_proofs and from_bytes maintain this; any
+    # code building a MODIFIED batch (e.g. dataclasses.replace in tests)
+    # must pass wire=None so verification re-derives the bytes — a stale
+    # cache would make the challenge binding vacuous for that object (the
+    # wire attack surface itself cannot diverge: from_bytes decodes tensors
+    # and cache from the same buffer).
+    wire: Optional[dict] = None
 
     @property
     def n_values(self) -> int:
@@ -171,22 +187,30 @@ class RangeProofBatch:
     def n_servers(self) -> int:
         return int(self.zv.shape[0])
 
+    def wire_bytes(self) -> dict:
+        """The canonical commitment bytes (compute-if-missing)."""
+        if self.wire is None:
+            self.wire = _range_wire_dict(self.commit, self.d, self.v_pts,
+                                         self.a)
+        return self.wire
+
     def to_bytes(self) -> bytes:
         """Canonical serialization (RangeProof.ToBytes, :92-146)."""
         head = np.asarray([self.u, self.l, self.n_values, self.n_servers],
-                          dtype=np.int64).tobytes()
+                          dtype="<i8").tobytes()
+        w = self.wire_bytes()
         parts = [
-            enc.ct_bytes(self.commit), enc.scalar_bytes(self.challenge),
-            enc.scalar_bytes(self.zr), enc.g1_bytes(self.d),
+            w["commit"], enc.scalar_bytes(self.challenge),
+            enc.scalar_bytes(self.zr), w["d"],
             enc.scalar_bytes(self.zphi), enc.scalar_bytes(self.zv),
-            enc.g2_bytes(self.v_pts), enc.gt_bytes(self.a),
+            w["v"], w["a"],
         ]
         return head + b"".join(np.ascontiguousarray(p).tobytes()
                                for p in parts)
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "RangeProofBatch":
-        u, l, V, ns = np.frombuffer(buf[:32], dtype=np.int64)
+        u, l, V, ns = np.frombuffer(buf[:32], dtype="<i8")
         u, l, V, ns = int(u), int(l), int(V), int(ns)
         off = 32
 
@@ -196,18 +220,24 @@ class RangeProofBatch:
             off += nbytes
             return flat.reshape(shape)
 
-        commit = _g1_from_bytes(take((V, 2, 64), V * 128)).reshape(
-            V, 2, 3, params.NUM_LIMBS)
+        commit_b = take((V, 2, 64), V * 128)
+        commit = _g1_from_bytes(commit_b).reshape(V, 2, 3, params.NUM_LIMBS)
         challenge = enc.bytes_to_limbs(take((V, 32), V * 32))
         zr = enc.bytes_to_limbs(take((V, 32), V * 32))
-        d = _g1_from_bytes(take((V, 64), V * 64))
+        d_b = take((V, 64), V * 64)
+        d = _g1_from_bytes(d_b)
         zphi = enc.bytes_to_limbs(take((V, l, 32), V * l * 32))
         zv = enc.bytes_to_limbs(take((ns, V, l, 32), ns * V * l * 32))
-        v_pts = _g2_from_bytes(take((ns, V, l, 128), ns * V * l * 128))
-        a = _gt_from_bytes(take((ns, V, l, 384), ns * V * l * 384))
+        v_b = take((ns, V, l, 128), ns * V * l * 128)
+        v_pts = _g2_from_bytes(v_b)
+        a_b = take((ns, V, l, 384), ns * V * l * 384)
+        a = _gt_from_bytes(a_b)
+        wire = {"commit": commit_b.reshape(V, 128).copy(), "d": d_b.copy(),
+                "v": v_b.copy(), "a": a_b.copy()}
         return cls(jnp.asarray(commit), jnp.asarray(challenge),
                    jnp.asarray(zr), jnp.asarray(d), jnp.asarray(zphi),
-                   jnp.asarray(zv), jnp.asarray(v_pts), jnp.asarray(a), u, l)
+                   jnp.asarray(zv), jnp.asarray(v_pts), jnp.asarray(a), u, l,
+                   wire=wire)
 
 
 def _g1_from_bytes(b: np.ndarray) -> np.ndarray:
@@ -339,9 +369,41 @@ def _weighted_sum_mod_n(s_plain, upow_m):
     return acc
 
 
-def proof_challenge(cts, sum_y_bytes: np.ndarray, d, v_pts, a,
-                    u: int, l: int) -> np.ndarray:
-    """Per-value Fiat-Shamir challenge binding ALL prover commitments:
+_BASE_B = None
+
+
+def _g1_gen_bytes() -> np.ndarray:
+    """Canonical bytes of the G1 generator — pure host, memoized (this used
+    to be a device normalize dispatch on EVERY challenge computation)."""
+    global _BASE_B
+    if _BASE_B is None:
+        _BASE_B = _g1_bytes_host(refimpl.G1)
+    return _BASE_B
+
+
+def _range_wire_dict(commit, d, v_pts, a) -> dict:
+    """THE one definition of the canonical commitment encoding — creation,
+    wire_bytes and the device-tensor challenge path all call this so the
+    Fiat-Shamir transcript can never desynchronize between them."""
+    return {"commit": enc.ct_bytes(jnp.asarray(commit)),
+            "d": enc.g1_bytes(jnp.asarray(d)),
+            "v": enc.g2_bytes(jnp.asarray(v_pts)),
+            "a": enc.gt_bytes(jnp.asarray(a))}
+
+
+def _g1_bytes_host(pt) -> np.ndarray:
+    """Canonical 64-byte encoding of a host affine int pair (no device);
+    None (infinity) encodes all-zero, matching enc.g1_bytes."""
+    if pt is None:
+        return np.zeros(64, dtype=np.uint8)
+    x, y = int(pt[0]), int(pt[1])
+    return np.frombuffer(x.to_bytes(32, "big") + y.to_bytes(32, "big"),
+                         dtype=np.uint8)
+
+
+def challenge_from_wire(wire: dict, sum_y_bytes: np.ndarray,
+                        u: int, l: int) -> np.ndarray:
+    """Per-value Fiat-Shamir challenge from the CANONICAL WIRE BYTES:
 
       c = sha3-512(B ‖ C2 ‖ ΣY ‖ u ‖ l ‖ D ‖ V_pts[·,v,·] ‖ a[·,v,·])
 
@@ -349,29 +411,36 @@ def proof_challenge(cts, sum_y_bytes: np.ndarray, d, v_pts, a,
     which lets a forger derive D and a AFTER fixing c (see module
     docstring). Binding D, the blinded signatures V and the pairing
     commitments a makes the transcript a proper sigma-protocol
-    Fiat-Shamir transform.
-
-    d: (V, 3, 16) G1; v_pts: (ns, V, l, 3, 2, 16) G2;
-    a: (ns, V, l, 6, 2, 16) GT. All canonicalized (normalized affine
-    bytes) before hashing so creator and verifier agree bit-exactly.
+    Fiat-Shamir transform. Pure host work: byte slicing + sha3.
     """
-    base_b = enc.g1_bytes(jnp.asarray(C.from_ref(refimpl.G1)))
-    c2 = enc.g1_bytes(cts[..., 1, :, :])
-    ul = np.asarray([u, l], dtype=np.int64).view(np.uint8)
-    d_b = enc.g1_bytes(jnp.asarray(d))                       # (V, 64)
-    v_b = np.moveaxis(enc.g2_bytes(jnp.asarray(v_pts)), 0, 1)
-    v_b = np.ascontiguousarray(v_b).reshape(v_b.shape[0], -1)  # (V, ns*l*128)
-    a_b = np.moveaxis(enc.gt_bytes(jnp.asarray(a)), 0, 1)
-    a_b = np.ascontiguousarray(a_b).reshape(a_b.shape[0], -1)  # (V, ns*l*384)
-    return enc.hash_to_scalar(base_b, c2, sum_y_bytes, ul, d_b, v_b, a_b,
-                              batch_shape=cts.shape[:-3])
+    # explicit little-endian so the transcript is canonical across hosts
+    # (all other hashed inputs go through explicit byte encoders)
+    ul = np.frombuffer(np.asarray([u, l], dtype="<i8").tobytes(),
+                       dtype=np.uint8)
+    V = wire["commit"].shape[0]
+    c2 = wire["commit"].reshape(V, 128)[:, 64:]              # (V, 64)
+    d_b = wire["d"]                                          # (V, 64)
+    v_b = np.moveaxis(wire["v"], 0, 1)
+    v_b = np.ascontiguousarray(v_b).reshape(V, -1)           # (V, ns*l*128)
+    a_b = np.moveaxis(wire["a"], 0, 1)
+    a_b = np.ascontiguousarray(a_b).reshape(V, -1)           # (V, ns*l*384)
+    return enc.hash_to_scalar(_g1_gen_bytes(), c2, sum_y_bytes, ul, d_b,
+                              v_b, a_b, batch_shape=(V,))
+
+
+def proof_challenge(cts, sum_y_bytes: np.ndarray, d, v_pts, a,
+                    u: int, l: int) -> np.ndarray:
+    """Challenge from DEVICE tensors: canonicalizes to bytes, then hashes
+    (see challenge_from_wire). Kept for callers without a byte cache."""
+    return challenge_from_wire(_range_wire_dict(cts, d, v_pts, a),
+                               sum_y_bytes, u, l)
 
 
 def sum_publics_bytes(sigs: list[RangeSig]) -> np.ndarray:
     acc = None
     for s in sigs:
         acc = refimpl.g1_add(acc, s.public)
-    return enc.g1_bytes(jnp.asarray(C.from_ref(acc)))
+    return _g1_bytes_host(acc)
 
 
 # ---------------------------------------------------------------------------
@@ -474,15 +543,18 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     A_tab = jnp.asarray(np.stack([sg.A for sg in sigs]))   # (ns, u, 3, 2, 16)
     gtA = sig_gt_table(sigs) if use_gt_table else None
 
-    # commit -> Fiat-Shamir (binds D, V_pts, a) -> respond
+    # commit -> Fiat-Shamir (binds D, V_pts, a) -> respond. The canonical
+    # commitment bytes are computed ONCE here and cached on the batch: they
+    # are both the hash input and the wire format (to_bytes reuses them).
     D, m_tot, V_pts, a = _commit_kernel(
         digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA)
-    c = jnp.asarray(proof_challenge(cts, sum_publics_bytes(sigs),
-                                    D, V_pts, a, u, l))
+    wire = _range_wire_dict(cts, D, V_pts, a)
+    c = jnp.asarray(challenge_from_wire(wire, sum_publics_bytes(sigs), u, l))
     zphi, zr, zv = _response_kernel(digits, c, jnp.asarray(rs), s, t,
                                     m_tot, v)
     return RangeProofBatch(commit=jnp.asarray(cts), challenge=c, zr=zr, d=D,
-                           zphi=zphi, zv=zv, v_pts=V_pts, a=a, u=u, l=l)
+                           zphi=zphi, zv=zv, v_pts=V_pts, a=a, u=u, l=l,
+                           wire=wire)
 
 
 # ---------------------------------------------------------------------------
@@ -547,13 +619,13 @@ def verify_range_proofs(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 def _challenge_ok(proof: RangeProofBatch, sigs_pub) -> np.ndarray:
     """Recompute c = H(B ‖ C2 ‖ ΣY ‖ u ‖ l ‖ D ‖ V ‖ a) from the
     TRANSMITTED commitments and require equality with the transmitted
-    challenge — a forger deriving D or a post-hoc changes c."""
+    challenge — a forger deriving D or a post-hoc changes c. Uses the
+    wire-byte cache (pure host hashing; zero device work on the verifier)."""
     acc = None
     for p in sigs_pub:
         acc = refimpl.g1_add(acc, p)
-    want = proof_challenge(proof.commit, enc.g1_bytes(
-        jnp.asarray(C.from_ref(acc))), proof.d, proof.v_pts, proof.a,
-        proof.u, proof.l)
+    want = challenge_from_wire(proof.wire_bytes(), _g1_bytes_host(acc),
+                               proof.u, proof.l)
     return np.all(np.asarray(proof.challenge) == want, axis=-1)
 
 
@@ -604,11 +676,13 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
         return False  # D equation / challenge binding failed — deterministic
     r = B.int_to_scalar(jnp.asarray(r_int))               # (ns, V, l, 16)
 
-    # r·(c·y_i − Zphi_j·B), then Miller only (final exp shared)
+    # r·(c·y_i − Zphi_j·B), then Miller only (final exp shared).
+    # g1_scalar_mul64: the RLC weights are 62-bit, so the weighting ladder
+    # runs 16 windows instead of 64
     cy = B.g1_scalar_mul(ys[:, None, :, :], c[None, :, :])
     nzphiB = B.fixed_base_mul(base_tbl, B.fn_neg(zphi))
     g1arg = B.g1_add(cy[:, :, None, :, :], nzphiB[None])  # (ns, V, l, 3, 16)
-    g1arg_r = B.g1_scalar_mul(g1arg, r)
+    g1arg_r = B.g1_scalar_mul64(g1arg, r)
     px, py, _ = B.g1_normalize(g1arg_r)
     qx, qy, _ = B.g2_normalize(proof.v_pts)
     sync(qx)
@@ -639,6 +713,8 @@ def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 
       * per-value D equation  D == c*C2 + Zr*P + (sum u^j Zphi_j)*B
       * binding Fiat-Shamir challenge recompute over D ‖ V ‖ a
+      * GΦ12 membership of every wire-provided a (gt_membership_ok —
+        required before the cyclotomic-squaring pow chains touch them)
       * verifier-secret 62-bit RLC weights r
       * [with_gtb_pow] gtB^(sum_ij r_ij*Zv_ij), the one fixed-base power
 
@@ -659,6 +735,7 @@ def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     ok = bool(np.all(np.asarray(B.g1_eq(Dp, proof.d))))
     if check_challenge:
         ok = ok and bool(np.all(_challenge_ok(proof, sigs_pub)))
+    ok = ok and B.gt_membership_ok(proof.a)
 
     if rng is None:
         rng = np.random.default_rng(
@@ -696,26 +773,26 @@ class RangeProofList:
 
     def to_bytes(self) -> bytes:
         head = np.asarray([self.n_values, len(self.batches)],
-                          dtype=np.int64).tobytes()
+                          dtype="<i8").tobytes()
         parts = [head]
         for idx, pb in self.batches:
             blob = pb.to_bytes()
-            idx = np.asarray(idx, dtype=np.int64)
+            idx = np.asarray(idx, dtype="<i8")
             parts.append(np.asarray([idx.size, len(blob)],
-                                    dtype=np.int64).tobytes())
+                                    dtype="<i8").tobytes())
             parts.append(idx.tobytes())
             parts.append(blob)
         return b"".join(parts)
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "RangeProofList":
-        n_values, n_batches = np.frombuffer(buf[:16], dtype=np.int64)
+        n_values, n_batches = np.frombuffer(buf[:16], dtype="<i8")
         off = 16
         batches = []
         for _ in range(int(n_batches)):
-            n_idx, n_blob = np.frombuffer(buf[off:off + 16], dtype=np.int64)
+            n_idx, n_blob = np.frombuffer(buf[off:off + 16], dtype="<i8")
             off += 16
-            idx = np.frombuffer(buf[off:off + 8 * int(n_idx)], dtype=np.int64)
+            idx = np.frombuffer(buf[off:off + 8 * int(n_idx)], dtype="<i8")
             off += 8 * int(n_idx)
             pb = RangeProofBatch.from_bytes(buf[off:off + int(n_blob)])
             off += int(n_blob)
@@ -753,12 +830,19 @@ def create_range_proof_list(key, secrets, rs, cts, ranges,
 
 def _slice_batch(pb: RangeProofBatch, sel: np.ndarray) -> RangeProofBatch:
     """Sub-batch along the value axis (proofs are per-value independent)."""
+    wire = None
+    if pb.wire is not None:
+        ns = np.asarray(sel)
+        wire = {"commit": pb.wire["commit"].reshape(
+                    pb.n_values, 128)[ns],
+                "d": pb.wire["d"][ns], "v": pb.wire["v"][:, ns],
+                "a": pb.wire["a"][:, ns]}
     sel = jnp.asarray(sel)
     return RangeProofBatch(
         commit=jnp.asarray(pb.commit)[sel], challenge=pb.challenge[sel],
         zr=pb.zr[sel], d=pb.d[sel], zphi=pb.zphi[sel],
         zv=pb.zv[:, sel], v_pts=pb.v_pts[:, sel], a=pb.a[:, sel],
-        u=pb.u, l=pb.l)
+        u=pb.u, l=pb.l, wire=wire)
 
 
 def create_range_proof_lists_batched(key, secrets_2d, rs_2d, cts_2d, ranges,
@@ -796,16 +880,45 @@ def create_range_proof_lists_batched(key, secrets_2d, rs_2d, cts_2d, ranges,
     return out
 
 
+def _batch_shapes_ok(pb: RangeProofBatch, ns_expected: int) -> bool:
+    """Tensor-shape consistency for a WIRE-DECODED batch: from_bytes trusts
+    the payload's own (u, l, V, ns) header, so a malicious DP can ship a
+    structurally-'valid' object whose ns disagrees with the published
+    signature roster or whose tensors disagree with each other — the joint
+    concat/broadcast would then raise and (before this guard) poison honest
+    neighbours' verdicts via the flush-level catch-all."""
+    NLb = params.NUM_LIMBS
+    try:
+        ns, l, V = pb.n_servers, int(pb.l), pb.n_values
+        return (ns == ns_expected and l >= 1 and V >= 1
+                and tuple(pb.commit.shape) == (V, 2, 3, NLb)
+                and tuple(pb.challenge.shape) == (V, NLb)
+                and tuple(pb.zr.shape) == (V, NLb)
+                and tuple(pb.d.shape) == (V, 3, NLb)
+                and tuple(pb.zphi.shape) == (V, l, NLb)
+                and tuple(pb.zv.shape) == (ns, V, l, NLb)
+                and tuple(pb.v_pts.shape) == (ns, V, l, 3, 2, NLb)
+                and tuple(pb.a.shape) == (ns, V, l, 6, 2, NLb))
+    except Exception:
+        return False
+
+
 def _list_structure_ok(lst: RangeProofList, ranges,
                        sigs_pub_by_u: dict) -> bool:
     """Coverage check: every output index with a nonzero (u, l) spec must be
     covered by exactly one batch carrying that exact spec (a prover cannot
-    substitute a looser range), and every batch's base must have published
-    signatures."""
+    substitute a looser range), every batch's base must have published
+    signatures, and every batch's ns/tensor shapes must be self-consistent
+    (see _batch_shapes_ok)."""
     want = group_ranges(ranges)
     covered = {}
     for ia, pb in lst.batches:
-        if sigs_pub_by_u.get(pb.u) is None:
+        sigs = sigs_pub_by_u.get(pb.u)
+        if sigs is None:
+            return False
+        if not _batch_shapes_ok(pb, len(sigs)):
+            return False
+        if len(np.asarray(ia)) != pb.n_values:
             return False
         for i in ia:
             if int(i) in covered:
@@ -818,6 +931,24 @@ def _list_structure_ok(lst: RangeProofList, ranges,
     return set(covered) == {i for idx in want.values() for i in idx}
 
 
+def _safe_batch_verify(pb: RangeProofBatch, sigs_pub, ca_pub_table) -> bool:
+    """verify_range_proofs_batch with exception containment: a payload that
+    still manages to crash the kernels (despite _batch_shapes_ok) is a
+    FAILED verification for ITSELF — the exception must never propagate to
+    the flush-level catch-all, which would mark every sampled payload
+    BM_FALSE and poison honest DPs' audit entries."""
+    try:
+        return verify_range_proofs_batch(pb, sigs_pub, ca_pub_table)
+    except Exception:
+        import traceback
+
+        from ..utils import log
+
+        log.warn("range batch verify raised (payload rejected): "
+                 + traceback.format_exc(limit=8))
+        return False
+
+
 def verify_range_proof_list(lst: RangeProofList, ranges,
                             sigs_pub_by_u: dict, ca_pub_table) -> bool:
     """Verify a mixed-range payload against the QUERY's specs (structure +
@@ -825,8 +956,7 @@ def verify_range_proof_list(lst: RangeProofList, ranges,
     if not _list_structure_ok(lst, ranges, sigs_pub_by_u):
         return False
     for ia, pb in lst.batches:
-        if not verify_range_proofs_batch(pb, sigs_pub_by_u[pb.u],
-                                         ca_pub_table):
+        if not _safe_batch_verify(pb, sigs_pub_by_u[pb.u], ca_pub_table):
             return False
     return True
 
@@ -836,6 +966,14 @@ def _concat_batches(pbs: list) -> RangeProofBatch:
     u, l = pbs[0].u, pbs[0].l
     assert all(pb.u == u and pb.l == l for pb in pbs)
     cat = lambda xs, ax: jnp.concatenate([jnp.asarray(x) for x in xs], ax)
+    wire = None
+    if all(pb.wire is not None for pb in pbs):
+        wire = {"commit": np.concatenate(
+                    [pb.wire["commit"].reshape(pb.n_values, 128)
+                     for pb in pbs], 0),
+                "d": np.concatenate([pb.wire["d"] for pb in pbs], 0),
+                "v": np.concatenate([pb.wire["v"] for pb in pbs], 1),
+                "a": np.concatenate([pb.wire["a"] for pb in pbs], 1)}
     return RangeProofBatch(
         commit=cat([pb.commit for pb in pbs], 0),
         challenge=cat([pb.challenge for pb in pbs], 0),
@@ -844,7 +982,7 @@ def _concat_batches(pbs: list) -> RangeProofBatch:
         zphi=cat([pb.zphi for pb in pbs], 0),
         zv=cat([pb.zv for pb in pbs], 1),
         v_pts=cat([pb.v_pts for pb in pbs], 1),
-        a=cat([pb.a for pb in pbs], 1), u=u, l=l)
+        a=cat([pb.a for pb in pbs], 1), u=u, l=l, wire=wire)
 
 
 def verify_range_proof_payloads_joint(datas: list, ranges,
@@ -892,8 +1030,8 @@ def verify_range_proof_lists_joint(lists: list, ranges, sigs_pub_by_u: dict,
         for _ia, pb in lists[i].batches:
             by_spec.setdefault((pb.u, pb.l), []).append(pb)
     joint_ok = all(
-        verify_range_proofs_batch(_concat_batches(pbs),
-                                  sigs_pub_by_u[u], ca_pub_table)
+        _safe_batch_verify(_concat_batches(pbs), sigs_pub_by_u[u],
+                           ca_pub_table)
         for (u, _l), pbs in by_spec.items())
     if joint_ok:
         return ok_struct

@@ -43,7 +43,7 @@ from ..proofs import requests as rq
 from ..proofs import shuffle as shuffle_proof
 from ..utils import log
 from ..utils.timers import PhaseTimers
-from .proof_collection import VerifyingNode, VNGroup
+from .proof_collection import VerifyCache, VerifyingNode, VNGroup
 from .query import (DiffPParams, Operation, Query, SurveyQuery,
                     check_parameters, choose_operation, query_to_proofs_nbrs)
 
@@ -111,7 +111,16 @@ class LocalCluster:
     """
 
     def __init__(self, n_cns: int = 3, n_dps: int = 5, n_vns: int = 3,
-                 seed: int = 1, dlog_limit: int = 10000):
+                 seed: int = 1, dlog_limit: int = 10000,
+                 link=None):
+        # link: an optional transport.LinkModel; when active, the in-process
+        # cluster sleeps at every boundary where the reference pays a real
+        # network message (DP ciphertext upload, proof delivery to each VN),
+        # so simulation rows reproduce the reference's delay/bandwidth
+        # sensitivity (simul/runfiles/drynx.toml:6-7) with real wall-clock
+        from .transport import LinkModel
+
+        self.link = link if link is not None else LinkModel()
         rng = np.random.default_rng(seed)
         self.rng = rng
         self.cns = [_new_identity(f"cn{i}", rng) for i in range(n_cns)]
@@ -142,9 +151,16 @@ class LocalCluster:
             import tempfile
 
             self._vn_dir = tempfile.mkdtemp(prefix="drynx_vn_")
+            # co-located VNs share ONE verification cache: identical proof
+            # payloads (e.g. the keyswitch batch every CN relays, or the
+            # joint range flush) verify once per process — real VNs on
+            # separate machines do this same work in parallel, so the
+            # single-chip wall time stays comparable (see VerifyCache)
+            shared_cache = VerifyCache()
             self.vns = VNGroup([
                 VerifyingNode(v.name, f"{self._vn_dir}/{v.name}.db", pubs,
-                              verify_fns=self._verify_fns(), seed=i)
+                              verify_fns=self._verify_fns(), seed=i,
+                              verify_cache=shared_cache)
                 for i, v in enumerate(self.vn_idents)])
 
         self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
@@ -462,6 +478,10 @@ class LocalCluster:
         f_enc, f_agg, f_ks, f_dec = self._fused()
         cts = f_enc(jnp.asarray(dp_stats), enc_rs)          # (n_dps, V, 2,3,16)
         cts.block_until_ready()
+        if self.link.active:
+            # one DP->CN upload per DP: V ciphertexts of 128 canonical bytes
+            for _ in self.dp_idents:
+                self.link.charge(V * 128)
         tm.end("DataCollectionProtocol")
 
         if proofs_on:
@@ -678,6 +698,10 @@ class LocalCluster:
                 req = rq.new_proof_request(
                     ptype, survey.sq.survey_id, ident.name,
                     f"{ptype}-{ident.name}", 0, data, ident.secret)
+                if self.link.active:
+                    # star fan-out: one prover->VN message per VN
+                    for _ in self.vns.vns:
+                        self.link.charge(len(data))
                 with lock:
                     self.vns.deliver(req)
             except BaseException:

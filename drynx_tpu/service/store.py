@@ -101,11 +101,23 @@ class ProofDB:
     def native(self) -> bool:
         return self._lib is not None
 
+    def _handle(self):
+        """Native handle, reopened on demand: close() marks the DB closed,
+        and later proof traffic transparently reopens the append-only log
+        instead of crashing into a dangling handle (a remote close_db can
+        arrive while the node keeps serving RPCs)."""
+        if self._lib is not None and not self._h:
+            self._h = self._lib.pdb_open(self.path.encode())
+            if not self._h:
+                raise OSError(f"proofdb: cannot reopen {self.path}")
+        return self._h
+
     def put(self, key: str | bytes, value: bytes) -> None:
         k = key.encode() if isinstance(key, str) else key
         with self._lock:
             if self._lib is not None:
-                rc = self._lib.pdb_put(self._h, k, len(k), value, len(value))
+                rc = self._lib.pdb_put(self._handle(), k, len(k), value,
+                                       len(value))
                 if rc != 0:
                     raise OSError("proofdb put failed")
             else:
@@ -120,30 +132,32 @@ class ProofDB:
         k = key.encode() if isinstance(key, str) else key
         with self._lock:
             if self._lib is not None:
-                n = self._lib.pdb_get(self._h, k, len(k), None, 0)
+                h = self._handle()
+                n = self._lib.pdb_get(h, k, len(k), None, 0)
                 if n < 0:
                     return None
                 buf = ctypes.create_string_buffer(int(n))
-                self._lib.pdb_get(self._h, k, len(k), buf, n)
+                self._lib.pdb_get(h, k, len(k), buf, n)
                 return buf.raw[:n]
             return self._mem.get(k)
 
     def keys(self) -> list[bytes]:
         with self._lock:
             if self._lib is not None:
+                h = self._handle()
                 out = []
-                count = self._lib.pdb_count(self._h)
+                count = self._lib.pdb_count(h)
                 for i in range(count):
-                    n = self._lib.pdb_key_at(self._h, i, None, 0)
+                    n = self._lib.pdb_key_at(h, i, None, 0)
                     buf = ctypes.create_string_buffer(int(n))
-                    self._lib.pdb_key_at(self._h, i, buf, n)
+                    self._lib.pdb_key_at(h, i, buf, n)
                     out.append(buf.raw[:n])
                 return out
             return list(self._order)
 
     def sync(self) -> None:
         with self._lock:
-            if self._lib is not None:
+            if self._lib is not None and self._h:
                 self._lib.pdb_sync(self._h)
 
     def close(self) -> None:

@@ -12,12 +12,61 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import socket
 import socketserver
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
+
+
+class LinkModel:
+    """Per-message link emulation: one-way delay + serialization time.
+
+    Mirrors the reference simulation's per-link network model
+    (simul/runfiles/drynx.toml:6-7: Delay = 20 ms, Bandwidth = 100 Mbps;
+    sensitivity study TIFS/networkTraffic.py). charge(n) sleeps
+    delay + n*8/bandwidth before the bytes move, so TCP runs and the
+    in-process simulation runner reproduce the reference's network rows
+    with real wall-clock, not post-hoc arithmetic.
+    """
+
+    def __init__(self, delay_ms: float = 0.0, bandwidth_mbps: float = 0.0):
+        self.delay_s = float(delay_ms) / 1e3
+        self.byte_s = (8.0 / (float(bandwidth_mbps) * 1e6)
+                       if bandwidth_mbps else 0.0)
+
+    @property
+    def active(self) -> bool:
+        return self.delay_s > 0 or self.byte_s > 0
+
+    def charge(self, n_bytes: int) -> None:
+        t = self.delay_s + n_bytes * self.byte_s
+        if t > 0:
+            time.sleep(t)
+
+    @classmethod
+    def from_env(cls) -> "LinkModel":
+        """DRYNX_LINK_DELAY_MS / DRYNX_LINK_MBPS (0 = off, the default)."""
+        return cls(float(os.environ.get("DRYNX_LINK_DELAY_MS", "0") or 0),
+                   float(os.environ.get("DRYNX_LINK_MBPS", "0") or 0))
+
+
+_LINK: Optional[LinkModel] = None
+
+
+def link_model() -> LinkModel:
+    global _LINK
+    if _LINK is None:
+        _LINK = LinkModel.from_env()
+    return _LINK
+
+
+def set_link_model(m: Optional[LinkModel]) -> None:
+    global _LINK
+    _LINK = m
 
 
 def b64(data: bytes) -> str:
@@ -41,6 +90,7 @@ def unpack_array(d: dict) -> np.ndarray:
 
 def send_msg(sock: socket.socket, obj: dict) -> None:
     raw = json.dumps(obj).encode()
+    link_model().charge(len(raw) + 4)
     sock.sendall(len(raw).to_bytes(4, "big") + raw)
 
 
@@ -140,4 +190,5 @@ class Conn:
 
 
 __all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
-           "recv_msg", "NodeServer", "Conn"]
+           "recv_msg", "NodeServer", "Conn", "LinkModel", "link_model",
+           "set_link_model"]

@@ -35,6 +35,11 @@ class SimulationConfig:
     diffp_scale: float = 0.0
     dlog_limit: int = 25000
     seed: int = 0
+    # per-link network model (reference simul/runfiles/drynx.toml:6-7:
+    # Delay = 20 ms, Bandwidth = 100 Mbps; sensitivity study
+    # TIFS/networkTraffic.py). 0 = ideal network (off).
+    delay_ms: float = 0.0
+    bandwidth_mbps: float = 0.0
 
     # reference runfile spellings (drynx_simul.go:28-80) -> our field names
     _ALIASES = {
@@ -42,13 +47,15 @@ class SimulationConfig:
         "nbrvns": "nbr_vns", "nbrrows": "rows_per_dp",
         "rangesu": "ranges_u", "rangesl": "ranges_l",
         "diffpsize": "diffp_size", "diffpscale": "diffp_scale",
+        "delay": "delay_ms", "bandwidth": "bandwidth_mbps",
+        "delayms": "delay_ms", "bandwidthmbps": "bandwidth_mbps",
     }
 
     # onet runfile boilerplate the reference tolerates (drynx_simul.go decodes
     # into a struct, extra TOML keys are simply unused) — ignore silently.
     _ONET_BOILERPLATE = {
         "simulation", "hosts", "rounds", "bf", "servers", "suite",
-        "bandwidth", "delay", "runwait", "monitor", "debug", "singlehost",
+        "runwait", "monitor", "debug", "singlehost",
         "tls", "cuttingfactor",
     }
 
@@ -76,11 +83,14 @@ def run_simulation(cfg: SimulationConfig) -> dict:
     from ..service.api import DrynxClient
     from ..service.query import DiffPParams
     from ..service.service import LocalCluster
+    from ..service.transport import LinkModel
 
     rng = np.random.default_rng(cfg.seed)
+    link = LinkModel(cfg.delay_ms, cfg.bandwidth_mbps)
     cluster = LocalCluster(n_cns=cfg.nbr_servers, n_dps=cfg.nbr_dps,
                            n_vns=cfg.nbr_vns if cfg.proofs else 0,
-                           seed=cfg.seed, dlog_limit=cfg.dlog_limit)
+                           seed=cfg.seed, dlog_limit=cfg.dlog_limit,
+                           link=link)
     for dp in cluster.dps.values():
         dp.data = rng.integers(cfg.query_min, max(cfg.query_max, 1),
                                size=(cfg.rows_per_dp,)).astype(np.int64)

@@ -53,3 +53,19 @@ def test_eq():
     assert not bool(C.eq(P, Q))
     assert bool(C.eq(C.infinity(), C.infinity()))
     assert not bool(C.eq(P, C.infinity()))
+
+
+def test_scalar_mul_short_matches_full():
+    """scalar_mul_short (truncated ladder for 62-bit RLC weights) agrees
+    with the full 256-bit ladder on in-range scalars, incl. k=0/1."""
+    import jax.numpy as jnp
+
+    from drynx_tpu.crypto import field as F
+
+    rng = random.Random(77)
+    ks = [0, 1, 2, rng.randrange(1 << 62), (1 << 62) - 1]
+    P = jnp.broadcast_to(C.from_ref(r.G1), (len(ks), 3, params.NUM_LIMBS))
+    k = jnp.asarray(np.stack([np.asarray(F.from_int(v)) for v in ks]))
+    full = C.scalar_mul(P, k)
+    short = C.scalar_mul_short(P, k, 64)
+    assert C.to_ref(short) == C.to_ref(full)

@@ -98,3 +98,52 @@ def test_pairing_bilinear_on_device():
     base = refimpl.pair(refimpl.G1, refimpl.G2)
     rhs = refimpl.fp12_pow(base, a * b)
     assert lhs == rhs
+
+
+def test_gt_membership_gate():
+    """GΦ12 membership: pairing outputs pass; a GT element multiplied by a
+    non-cyclotomic unit fails — the gate that keeps forged wire elements
+    away from the cyclotomic-squaring pow chains (batching.gt_membership_ok).
+    """
+    import numpy as np
+
+    from drynx_tpu.crypto import batching as B
+    from drynx_tpu.crypto import fp12 as F12
+
+    f = jnp.asarray(F12.from_ref(refimpl.pair(refimpl.G1, refimpl.G2)))
+    assert B.gt_membership_ok(f[None])
+    # conj6(f) = f^-1 for members: also a member
+    assert B.gt_membership_ok(F12.conj6(f)[None])
+    # a unit outside GΦ12: the Fp12 element 1 + w (invertible, generic)
+    g = [tuple(c) for c in refimpl.FP12_ONE]
+    g[1] = (1, 0)
+    bad = jnp.asarray(F12.from_ref(g))
+    assert not B.gt_membership_ok(bad[None])
+    # mixed batch: one bad element fails the whole batch
+    both = jnp.stack([f, bad])
+    assert not B.gt_membership_ok(both)
+
+
+def test_host_oracle_final_exp_fast_parity():
+    """host_oracle.final_exp_fast (easy + Olivos hard part on ints) must be
+    bit-identical to refimpl.final_exp (the naive full exponentiation) on
+    Miller outputs — it backs every CPU-path pairing in the proof layer."""
+    from drynx_tpu.crypto import host_oracle as ho
+
+    m = refimpl.ate_miller_loop(refimpl.g1_mul(refimpl.G1, 7), refimpl.G2)
+    assert ho.final_exp_fast(m) == refimpl.final_exp(m)
+    # and therefore the full host pairing equals refimpl.pair
+    import numpy as np
+
+    from drynx_tpu.crypto import curve as Cv
+    from drynx_tpu.crypto import g2 as G2m
+    from drynx_tpu.crypto import batching as B
+
+    p = Cv.from_ref(refimpl.g1_mul(refimpl.G1, 7))[None]
+    q = jnp.asarray(G2m.from_ref(refimpl.G2))[None]
+    px, py, _ = B.g1_normalize(p)
+    qx, qy, _ = B.g2_normalize(q)
+    got = ho.pair_host(np.asarray(px), np.asarray(py), np.asarray(qx),
+                       np.asarray(qy))
+    want = refimpl.pair(refimpl.g1_mul(refimpl.G1, 7), refimpl.G2)
+    assert F12.to_ref(jnp.asarray(got[0])) == want

@@ -105,3 +105,29 @@ def test_mulreduce8_and_fixed_base_pow():
     k = jnp.asarray(F.from_int(e))[None]
     got = pp.gt_pow_fixed(tab, k)
     assert F12.to_ref(got[0]) == refimpl.fp12_pow(gtb, e)
+
+
+def test_csqr_kernel_matches_generic_square_on_cyclotomic():
+    """Granger-Scott cyclotomic squaring == generic squaring on GΦ12
+    elements (pairing outputs); also via the wpow cyc=True chain."""
+    f = refimpl.pair(refimpl.G1, refimpl.G2)
+    df = jnp.asarray(F12.from_ref(f))[None]
+    got = pp.f12_csqr_flat(df)
+    assert F12.to_ref(got[0]) == refimpl.fp12_mul(f, f)
+
+    e = 0xDEADBEEFCAFE
+    k = jnp.asarray(F.from_int(e))[None]
+    got = pp.f12_wpow_flat(df, k, n_bits=48, cyc=True)
+    assert F12.to_ref(got[0]) == refimpl.fp12_pow(f, e)
+
+
+def test_scalar_mul_kernel_short_windows():
+    """n_windows=16 ladder == full ladder for 62-bit scalars (G1)."""
+    from drynx_tpu.crypto import curve as C
+
+    k_int = int.from_bytes(RNG.bytes(7), "little")  # < 2^56
+    pt = jnp.asarray(C.from_ref(refimpl.G1))[None]
+    k = jnp.asarray(F.from_int(k_int))[None]
+    full = po.scalar_mul_flat(pt, k)
+    short = po.scalar_mul_flat(pt, k, n_windows=16)
+    assert bool(np.all(np.asarray(C.eq(full, short))))

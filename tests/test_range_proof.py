@@ -120,12 +120,15 @@ def test_range_proof_rlc_batch_verify(setup):
         jax.random.PRNGKey(6), values, rs, cts, sigs, U, L, ca_tbl.table)
     rng = np.random.default_rng(1)
     assert rp.verify_range_proofs_batch(proof, pubs, ca_tbl.table, rng=rng)
-    # tampered a (one GT element replaced) -> reject
+    # tampered a (one GT element replaced) -> reject. wire=None: a modified
+    # batch must drop the canonical-byte cache (RangeProofBatch invariant);
+    # verification then re-encodes the tampered tensors, which is exactly
+    # what a wire-level tamper would deliver.
     bad_a = np.asarray(proof.a).copy()
     bad_a[0, 1] = np.asarray(F12.from_ref(refimpl.pair(refimpl.G1,
                                                        refimpl.G2)))
     import dataclasses as dc
-    bad = dc.replace(proof, a=jnp.asarray(bad_a))
+    bad = dc.replace(proof, a=jnp.asarray(bad_a), wire=None)
     assert not rp.verify_range_proofs_batch(bad, pubs, ca_tbl.table,
                                             rng=np.random.default_rng(2))
     # tampered zv -> reject
@@ -211,8 +214,10 @@ def test_rlc_small_order_forgery_rejected(setup):
     cts, rs = eg.encrypt_ints(jax.random.PRNGKey(51), ca_tbl, values)
     proof = rp.create_range_proofs(
         jax.random.PRNGKey(52), values, rs, cts, sigs, U, L, ca_tbl.table)
-    neg_a = F.neg(jnp.asarray(proof.a), F.FP)   # -a: order-2 RLC factor
-    bad = dc.replace(proof, a=neg_a)
+    # -a: order-2 RLC factor; wire=None so the verifier hashes the tampered
+    # encoding (what the wire would carry) — see RangeProofBatch invariant
+    neg_a = F.neg(jnp.asarray(proof.a), F.FP)
+    bad = dc.replace(proof, a=neg_a, wire=None)
     for seed in range(8):
         assert not rp.verify_range_proofs_batch(
             bad, pubs, ca_tbl.table, rng=np.random.default_rng(seed)), \

@@ -213,3 +213,38 @@ def test_remote_survey_sum(tmp_path):
     assert result == want
     for n in nodes:
         n.stop()
+
+
+def test_link_model_charges_wall_clock():
+    """The sleep-based per-link model (reference drynx.toml Delay/Bandwidth)
+    adds delay + bytes/bandwidth per message, and env config wires it into
+    send_msg."""
+    import time
+
+    from drynx_tpu.service.transport import LinkModel
+
+    m = LinkModel(delay_ms=5, bandwidth_mbps=8)   # 8 Mbps = 1 byte/us
+    t0 = time.perf_counter()
+    m.charge(10_000)                               # 5 ms + 10 ms
+    dt = time.perf_counter() - t0
+    assert 0.014 <= dt <= 0.5
+    assert not LinkModel().active
+    assert LinkModel(delay_ms=1).active and LinkModel(bandwidth_mbps=1).active
+
+
+@pytest.mark.slow
+def test_link_model_in_cluster_survey():
+    """A LocalCluster with a link model pays per-DP upload latency: the
+    DataCollection phase of a tiny no-proofs survey must include at least
+    n_dps * delay of modeled network time."""
+    from drynx_tpu.service.service import LocalCluster
+    from drynx_tpu.service.transport import LinkModel
+
+    n_dps = 4
+    cluster = LocalCluster(n_cns=2, n_dps=n_dps, n_vns=0, seed=3,
+                           dlog_limit=2000, link=LinkModel(delay_ms=50))
+    sq = cluster.generate_survey_query("sum", query_min=0, query_max=10)
+    res = cluster.run_survey(sq)
+    assert res.timers.items()
+    phases = dict(res.timers.items())
+    assert phases["DataCollectionProtocol"] >= n_dps * 0.05
