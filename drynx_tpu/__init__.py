@@ -25,3 +25,27 @@ if _cache and _cache != "off" and not jax.config.jax_compilation_cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+# Serialize XLA compiles process-wide. This framework is deliberately
+# multi-threaded at the service layer (VN verifiers, proof threads, TCP
+# handlers), and two Python threads entering XLA's CPU backend_compile
+# concurrently segfault/abort it under load (observed killing pytest
+# workers; the tunneled TPU compile service has also failed under
+# concurrent compiles). Compiles are rare and cached — serializing them
+# costs nothing; kill-switch DRYNX_NO_COMPILE_LOCK=1.
+if os.environ.get("DRYNX_NO_COMPILE_LOCK", "0") != "1":
+    try:
+        import threading as _threading
+
+        from jax._src import compiler as _jax_compiler
+
+        _orig_bcl = _jax_compiler.backend_compile_and_load
+        _compile_lock = _threading.Lock()
+
+        def _locked_backend_compile(*args, **kwargs):
+            with _compile_lock:
+                return _orig_bcl(*args, **kwargs)
+
+        _jax_compiler.backend_compile_and_load = _locked_backend_compile
+    except Exception:   # jax internals moved: lose the guard, not the app
+        pass

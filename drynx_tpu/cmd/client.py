@@ -184,6 +184,9 @@ def _jsonable(x):
 
 
 def main(argv=None) -> int:
+    from ..utils.backend import pin_platform_from_env
+
+    pin_platform_from_env()   # a down TPU tunnel must not hang CPU clients
     p = argparse.ArgumentParser(prog="drynx-client")
     sub = p.add_subparsers(dest="group", required=True)
 

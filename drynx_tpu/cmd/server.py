@@ -64,6 +64,9 @@ def cmd_run(args) -> int:
 
 
 def main(argv=None) -> int:
+    from ..utils.backend import pin_platform_from_env
+
+    pin_platform_from_env()   # a down TPU tunnel must not hang CPU nodes
     p = argparse.ArgumentParser(prog="drynx-server")
     sub = p.add_subparsers(dest="cmd", required=True)
     g = sub.add_parser("gen", help="generate node config TOML on stdout")

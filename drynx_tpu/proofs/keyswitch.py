@@ -24,10 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..crypto import curve as C
 from ..crypto import elgamal as eg
-from ..crypto import field as F
-from ..crypto.field import FN
 from . import encoding as enc
 
 
@@ -92,21 +89,28 @@ def _challenge_from_wire(w: dict, ns: int, V: int) -> jnp.ndarray:
         batch_shape=(ns, V)))
 
 
-@jax.jit
 def _commit_kernel(orig_k, q_tbl, wr, wx):
+    """Built from the SHARED bucketed primitives (crypto/batching.py):
+    a monolithic jit here duplicated four 256-step ladder graphs into a
+    fresh program per (ns, V) shape — XLA's CPU compiler aborted under the
+    accumulated load of a full-suite run, and every new survey shape paid
+    a fresh compile. The bucketed kernels are compiled once per size
+    bucket, shared with every other proof path."""
+    from ..crypto import batching as B
+
     base = eg.BASE_TABLE.table
-    a1 = eg.fixed_base_mul(base, wr)
-    a2 = C.add(eg.fixed_base_mul(q_tbl, wr),
-               C.neg(C.scalar_mul(orig_k, wx)))
-    a3 = eg.fixed_base_mul(base, wx)
+    a1 = B.fixed_base_mul(base, wr)
+    a2 = B.g1_add(B.fixed_base_mul(q_tbl, wr),
+                  B.g1_neg(B.g1_scalar_mul(orig_k, wx)))
+    a3 = B.fixed_base_mul(base, wx)
     return a1, a2, a3
 
 
-@jax.jit
 def _response_kernel(wr, wx, c, r, x):
-    cm = F.to_mont(c, FN)
-    zr = F.add(wr, F.mont_mul(cm, r, FN), FN)
-    zx = F.add(wx, F.mont_mul(cm, x, FN), FN)
+    from ..crypto import batching as B
+
+    zr = B.fn_add(wr, B.fn_mul_plain(c, r))
+    zx = B.fn_add(wx, B.fn_mul_plain(c, x))
     return zr, zx
 
 
@@ -140,17 +144,19 @@ def create_keyswitch_proofs(key, orig_k, srv_x, ks_rs, q_pt, q_tbl,
     return pb
 
 
-@jax.jit
 def _verify_kernel(orig_k, u_pts, w_pts, ys, q_tbl, a1, a2, a3, c, zr, zx):
+    """Shared bucketed primitives — see _commit_kernel's note."""
+    from ..crypto import batching as B
+
     base = eg.BASE_TABLE.table
-    ok1 = C.eq(eg.fixed_base_mul(base, zr),
-               C.add(a1, C.scalar_mul(u_pts, c)))
-    lhs2 = C.add(eg.fixed_base_mul(q_tbl, zr),
-                 C.neg(C.scalar_mul(orig_k, zx)))
-    ok2 = C.eq(lhs2, C.add(a2, C.scalar_mul(w_pts, c)))
-    ok3 = C.eq(eg.fixed_base_mul(base, zx),
-               C.add(a3, C.scalar_mul(ys[:, None], c)))
-    return ok1 & ok2 & ok3
+    ok1 = B.g1_eq(B.fixed_base_mul(base, zr),
+                  B.g1_add(a1, B.g1_scalar_mul(u_pts, c)))
+    lhs2 = B.g1_add(B.fixed_base_mul(q_tbl, zr),
+                    B.g1_neg(B.g1_scalar_mul(orig_k, zx)))
+    ok2 = B.g1_eq(lhs2, B.g1_add(a2, B.g1_scalar_mul(w_pts, c)))
+    ok3 = B.g1_eq(B.fixed_base_mul(base, zx),
+                  B.g1_add(a3, B.g1_scalar_mul(ys[:, None], c)))
+    return jnp.asarray(ok1) & jnp.asarray(ok2) & jnp.asarray(ok3)
 
 
 def verify_keyswitch_proofs(proof: KeySwitchProofBatch, q_tbl) -> np.ndarray:

@@ -18,14 +18,10 @@ from __future__ import annotations
 
 import dataclasses
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..crypto import curve as C
 from ..crypto import elgamal as eg
-from ..crypto import field as F
-from ..crypto.field import FN
 from . import encoding as enc
 
 
@@ -51,16 +47,19 @@ class ObfuscationProofBatch:
                                for p in parts)
 
 
-@jax.jit
 def _commit_kernel(ct, w):
+    # shared bucketed primitives: a monolithic jit here re-compiled the
+    # 256-step ladder graphs per V shape (see keyswitch._commit_kernel)
+    from ..crypto import batching as B
+
     K, Cc = ct[..., 0, :, :], ct[..., 1, :, :]
-    return C.scalar_mul(K, w), C.scalar_mul(Cc, w)
+    return B.g1_scalar_mul(K, w), B.g1_scalar_mul(Cc, w)
 
 
-@jax.jit
 def _response_kernel(w, c, s):
-    cs = F.mont_mul(F.to_mont(c, FN), s, FN)
-    return F.add(w, cs, FN)
+    from ..crypto import batching as B
+
+    return B.fn_add(w, B.fn_mul_plain(c, s))
 
 
 def _challenge(orig, obf, a1, a2) -> jnp.ndarray:
@@ -81,13 +80,16 @@ def create_obfuscation_proofs(key, ct, s) -> ObfuscationProofBatch:
                                  challenge=c, z=z)
 
 
-@jax.jit
 def _verify_kernel(orig, obf, a1, a2, c, z):
+    from ..crypto import batching as B
+
     K, Cc = orig[..., 0, :, :], orig[..., 1, :, :]
     Kp, Cp = obf[..., 0, :, :], obf[..., 1, :, :]
-    ok1 = C.eq(C.scalar_mul(K, z), C.add(a1, C.scalar_mul(Kp, c)))
-    ok2 = C.eq(C.scalar_mul(Cc, z), C.add(a2, C.scalar_mul(Cp, c)))
-    return ok1 & ok2
+    ok1 = B.g1_eq(B.g1_scalar_mul(K, z),
+                  B.g1_add(a1, B.g1_scalar_mul(Kp, c)))
+    ok2 = B.g1_eq(B.g1_scalar_mul(Cc, z),
+                  B.g1_add(a2, B.g1_scalar_mul(Cp, c)))
+    return jnp.asarray(ok1) & jnp.asarray(ok2)
 
 
 def verify_obfuscation_proofs(proof: ObfuscationProofBatch) -> np.ndarray:

@@ -42,6 +42,14 @@ class SurveyProofState:
     range_flushed: bool = False
 
 
+# One payload verification at a time per process: VN handler threads (a
+# thread per TCP connection, or the LocalCluster fan-out) verifying
+# concurrently means CONCURRENT XLA compiles, which segfault the CPU
+# compiler under load (see pytest.ini). Verification throughput comes from
+# batching inside one call, not from thread overlap.
+_VERIFY_DEVICE_LOCK = threading.Lock()
+
+
 class VerifyCache:
     """Process-local memoization of payload-verification verdicts, keyed by
     (proof type, survey, payload digest).
@@ -144,8 +152,12 @@ class VerifyingNode:
 
             def vfn(data, sid, _base=vfn, _pt=req.proof_type):
                 key = (_pt, sid, hashlib.sha256(data).digest())
-                return self.verify_cache.get_or_compute(
-                    key, lambda: _base(data, sid))
+
+                def compute():
+                    with _VERIFY_DEVICE_LOCK:
+                        return _base(data, sid)
+
+                return self.verify_cache.get_or_compute(key, compute)
         code = (rq.BM_BADSIG if pub is None else rq.verify_proof_request(
             req, pub, sample, vfn, self.rng))
         self._echo_verify(req, t0, code)
@@ -206,8 +218,9 @@ class VerifyingNode:
 
         def compute():
             try:
-                return joint([pending[k][0].data for k in to_verify],
-                             req.survey_id)
+                with _VERIFY_DEVICE_LOCK:
+                    return joint([pending[k][0].data for k in to_verify],
+                                 req.survey_id)
             except Exception:
                 # malformed payloads are FAILED verifications, not crashes
                 # (mirrors rq.verify_proof_request's containment)

@@ -688,7 +688,15 @@ class LocalCluster:
         the tunneled TPU worker (round-1 note; reproduced in round 2 with 10
         concurrent range-proof creations). Threads still overlap with the
         main phase path's host work.
+
+        On CPU (no Pallas) the proof work runs INLINE instead: overlap buys
+        nothing on one core, and XLA's CPU compiler has segfaulted under
+        CONCURRENT compiles (a proof thread compiling the keyswitch verify
+        kernel while the main phase path compiles — observed killing a
+        pytest worker; same crash class as pytest.ini's isolation note).
         """
+        from ..crypto import pallas_ops as po
+
         lock = self._proof_device_lock
 
         def work():
@@ -714,6 +722,9 @@ class LocalCluster:
                          f"{traceback.format_exc()}")
                 raise
 
+        if not po.available():
+            work()   # synchronous on CPU; build errors surface immediately
+            return
         t = threading.Thread(target=work, daemon=True)
         t.start()
         survey.proof_threads.append(t)

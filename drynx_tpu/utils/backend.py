@@ -1,0 +1,27 @@
+"""Backend pinning for CLI entrypoints.
+
+The JAX_PLATFORMS environment variable is snapshotted before user code runs
+when a sitecustomize-registered accelerator plugin imports jax at interpreter
+start; worse, such a plugin can hijack backend resolution so that a DOWN
+accelerator tunnel hangs jax.devices() forever even with JAX_PLATFORMS=cpu
+in the environment. jax.config.update is the reliable override — apply it
+from the env var before the first backend use (tests/conftest.py does the
+same for the test tier).
+"""
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        # pass the value VERBATIM: it may be a priority list ("tpu,cpu")
+        # whose fallback entries jax honors — truncating would discard the
+        # CPU fallback this helper exists to preserve
+        jax.config.update("jax_platforms", plat)
+
+
+__all__ = ["pin_platform_from_env"]

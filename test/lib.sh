@@ -10,6 +10,10 @@ export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
 # unoptimized CPU codegen (the crypto graphs otherwise compile for ages and
 # the auto-detected ISA has SIGILL'd — see tests/conftest.py)
 export JAX_PLATFORMS=cpu
+# a registered TPU plugin can hijack backend resolution and HANG every node
+# process when its tunnel is down (env JAX_PLATFORMS alone does not stop
+# it); drop the registration trigger entirely for the CPU e2e tier
+unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_cpu_max_isa=AVX2 --xla_backend_optimization_level=0"
 
 SERVER="python -m drynx_tpu.cmd.server"

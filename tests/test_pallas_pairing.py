@@ -131,3 +131,32 @@ def test_scalar_mul_kernel_short_windows():
     full = po.scalar_mul_flat(pt, k)
     short = po.scalar_mul_flat(pt, k, n_windows=16)
     assert bool(np.all(np.asarray(C.eq(full, short))))
+
+
+def test_gt_pow_fixed_multi_matches_oracle():
+    """Creation's multi-base fixed-window pow: gather + mulreduce8 ==
+    fp12_pow on the selected base (interpret mode)."""
+    from drynx_tpu.crypto import host_oracle as ho
+
+    bases = [refimpl.pair(refimpl.g1_mul(refimpl.G1, i + 2), refimpl.G2)
+             for i in range(3)]
+    NB = len(bases)
+    T = np.empty((NB, 64, 16, 6, 2, 16), np.uint32)
+    for b, cur0 in enumerate(bases):
+        cur = cur0
+        for w in range(64):
+            row = refimpl.FP12_ONE
+            T[b, w, 0] = ho._fp12_from_ref(row)
+            for j in range(1, 16):
+                row = refimpl.fp12_mul(row, cur)
+                T[b, w, j] = ho._fp12_from_ref(row)
+            for _ in range(4):
+                cur = refimpl.fp12_sq(cur)
+    es = [0x123456789ABCDEF0, 7, int.from_bytes(RNG.bytes(30), "little")]
+    idx = jnp.asarray([2, 0, 1], dtype=jnp.int32)
+    k = jnp.asarray(np.stack([np.asarray(F.from_int(e % params.N))
+                              for e in es]))
+    got = pp.gt_pow_fixed_multi(jnp.asarray(T), idx, k)
+    for i, e in enumerate(es):
+        want = refimpl.fp12_pow(bases[int(idx[i])], e % params.N)
+        assert F12.to_ref(got[i]) == want, i

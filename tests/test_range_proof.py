@@ -222,3 +222,21 @@ def test_rlc_small_order_forgery_rejected(setup):
         assert not rp.verify_range_proofs_batch(
             bad, pubs, ca_tbl.table, rng=np.random.default_rng(seed)), \
             f"small-order forgery accepted with rng seed {seed}"
+
+
+def test_sig_gt_pow_tables_entries(setup):
+    """Per-base GT window tables (creation's squaring-free digit pow):
+    T[b][w][j] must equal gtA_b^(j * 16^w) — checked against the oracle on
+    a small signature set."""
+    from drynx_tpu.crypto import host_oracle as ho
+
+    sigs, _, _, _ = setup
+    T = rp.sig_gt_pow_tables(sigs)
+    ns, u = len(sigs), sigs[0].u
+    assert T.shape == (ns * u, 64, 16, 6, 2, 16)
+    gtA = np.asarray(rp.sig_gt_table(sigs))
+    for b, w, j in [(0, 0, 0), (0, 0, 1), (1, 0, 3), (ns * u - 1, 2, 5)]:
+        base = ho._fp12_to_ref(gtA[b // u, b % u])
+        want = refimpl.fp12_pow(base, j * (16 ** w))
+        got = ho._fp12_to_ref(T[b, w, j])
+        assert got == want, (b, w, j)
