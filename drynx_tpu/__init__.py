@@ -13,6 +13,16 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+# Pin the backend from JAX_PLATFORMS HERE — before any crypto module's
+# import-time jnp op can initialize a backend. The env var alone is not
+# enough: a sitecustomize-registered accelerator plugin snapshots it before
+# user code runs and can hijack backend resolution, so a DOWN tunnel hangs
+# the first dispatch even with JAX_PLATFORMS=cpu in the env. Pinning at
+# package import covers every entrypoint (CLI, scripts, tests).
+_plat = os.environ.get("JAX_PLATFORMS")
+if _plat:
+    jax.config.update("jax_platforms", _plat)
+
 # Persistent XLA compilation cache: OPT-IN via DRYNX_JAX_CACHE=<dir>.
 # Disabled by default because jaxlib has been observed to segfault when
 # deserializing the very large crypto-kernel executables back out of the
@@ -26,13 +36,20 @@ if _cache and _cache != "off" and not jax.config.jax_compilation_cache_dir:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-# Serialize XLA compiles process-wide. This framework is deliberately
-# multi-threaded at the service layer (VN verifiers, proof threads, TCP
-# handlers), and two Python threads entering XLA's CPU backend_compile
-# concurrently segfault/abort it under load (observed killing pytest
-# workers; the tunneled TPU compile service has also failed under
-# concurrent compiles). Compiles are rare and cached — serializing them
-# costs nothing; kill-switch DRYNX_NO_COMPILE_LOCK=1.
+# Serialize XLA compiles process-wide AND run each on a dedicated
+# fresh-stacked thread. Two reasons, both observed killing processes:
+#   1. Two Python threads entering XLA's CPU backend_compile concurrently
+#      segfault/abort the compiler under load (this framework is
+#      deliberately multi-threaded at the service layer — VN verifiers,
+#      proof threads, TCP handlers).
+#   2. Even a SINGLE compile segfaults in a long-lived process once the
+#      MAIN thread's stack has grown into an adjacent mapping (XLA's CPU
+#      pipeline recurses deeply on the crypto graphs; pytest workers died
+#      mid-suite on compiles that pass in isolation).
+# Running the compile on a fresh thread with an explicit 512 MB stack gives
+# every compile a clean, collision-free stack; the lock keeps them one at a
+# time. Compiles are rare and cached — the thread spawn is noise.
+# Kill-switch: DRYNX_NO_COMPILE_LOCK=1.
 if os.environ.get("DRYNX_NO_COMPILE_LOCK", "0") != "1":
     try:
         import threading as _threading
@@ -41,10 +58,28 @@ if os.environ.get("DRYNX_NO_COMPILE_LOCK", "0") != "1":
 
         _orig_bcl = _jax_compiler.backend_compile_and_load
         _compile_lock = _threading.Lock()
+        _COMPILE_STACK = 512 * 1024 * 1024
 
         def _locked_backend_compile(*args, **kwargs):
             with _compile_lock:
-                return _orig_bcl(*args, **kwargs)
+                box: dict = {}
+
+                def run():
+                    try:
+                        box["v"] = _orig_bcl(*args, **kwargs)
+                    except BaseException as e:   # re-raised on the caller
+                        box["e"] = e
+
+                old = _threading.stack_size(_COMPILE_STACK)
+                try:
+                    t = _threading.Thread(target=run, name="drynx-compile")
+                    t.start()
+                finally:
+                    _threading.stack_size(old)
+                t.join()
+                if "e" in box:
+                    raise box["e"]
+                return box["v"]
 
         _jax_compiler.backend_compile_and_load = _locked_backend_compile
     except Exception:   # jax internals moved: lose the guard, not the app

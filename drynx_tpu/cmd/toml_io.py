@@ -26,6 +26,25 @@ def _fmt_val(v) -> str:
     raise TypeError(f"unsupported TOML value {type(v)}")
 
 
+def _emit_table(out: list, name: str, item: dict, is_array: bool) -> None:
+    out.append("")
+    out.append(f"[[{name}]]" if is_array else f"[{name}]")
+    nested = []
+    for k, v in item.items():
+        if isinstance(v, dict):
+            if is_array:
+                # [[name]] + [name.k] would attach to the LAST array element
+                # in TOML semantics — ambiguous; nothing in the config shape
+                # needs it
+                raise TypeError(
+                    f"nested table {k!r} inside array-of-tables {name!r}")
+            nested.append((f"{name}.{k}", v))
+        else:
+            out.append(f"{k} = {_fmt_val(v)}")
+    for sub, v in nested:
+        _emit_table(out, sub, v, False)   # dotted header: [survey.lr]
+
+
 def dumps(d: dict) -> str:
     out = []
     tables = []
@@ -38,10 +57,7 @@ def dumps(d: dict) -> str:
             out.append(f"{k} = {_fmt_val(v)}")
     for name, items, is_array in tables:
         for item in items:
-            out.append("")
-            out.append(f"[[{name}]]" if is_array else f"[{name}]")
-            for k, v in item.items():
-                out.append(f"{k} = {_fmt_val(v)}")
+            _emit_table(out, name, item, is_array)
     return "\n".join(out) + "\n"
 
 

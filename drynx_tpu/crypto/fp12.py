@@ -9,6 +9,8 @@ split a = (c0, c2, c4), b = (c1, c3, c5): 1/(a + w b) = (a - w b)/(a^2 - v b^2).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -145,19 +147,26 @@ def pow_const(f, e: int):
     return acc
 
 
-@jax.jit
-def pow_var(f, k_limbs):
+@functools.partial(jax.jit, static_argnames="n_bits")
+def pow_var(f, k_limbs, n_bits: int = 256):
     """f^k for a VARIABLE mod-n exponent given as plain limbs (..., 16).
 
-    256-step square-and-multiply-always scan; batches over leading dims of
-    both f (..., 6, 2, 16) and k. The range-proof layer uses this to turn
-    e(t·B, B2) into gtB^t with one precomputed pairing (reference computes
-    the full pairing per element, lib/range/range_proof.go:398-404).
+    n_bits-step square-and-multiply-always scan (LSB-first; n_bits < 256
+    truncates for exponents known short, e.g. 62-bit RLC weights — a 4x
+    smaller graph, which matters for the shard_map compile); batches over
+    leading dims of both f (..., 6, 2, 16) and k. The range-proof layer
+    uses this to turn e(t·B, B2) into gtB^t with one precomputed pairing
+    (reference computes the full pairing per element,
+    lib/range/range_proof.go:398-404).
     """
     from .params import LIMB_BITS
     bits = (k_limbs[..., :, None]
             >> jnp.arange(LIMB_BITS, dtype=jnp.uint32)) & 1
     bits = bits.reshape(bits.shape[:-2] + (256,))
+    if n_bits < 256:
+        # lax.slice_in_dim: jnp basic indexing rejects the (static) stop
+        # under shard_map tracing with a spurious "must be static" error
+        bits = jax.lax.slice_in_dim(bits, 0, n_bits, axis=-1)
     bits_t = jnp.moveaxis(bits, -1, 0)
 
     batch = jnp.broadcast_shapes(f.shape[:-3], k_limbs.shape[:-1])

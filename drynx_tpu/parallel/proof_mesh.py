@@ -101,7 +101,9 @@ def rlc_total_sharded(mesh, proof, sigs_pub, r_int, gtb_pow_s):
             # safe: rlc_prelude gated a through gt_membership_ok
             ar = ppair.f12_wpow_flat(ca, rr, n_bits=63, cyc=True)
         else:
-            ar = F12.pow_var(ca, rr)
+            # 63-bit truncated scan: the weights are 62-bit and the full
+            # 256-step graph quadruples the (already heavy) shard compile
+            ar = F12.pow_var(ca, rr, n_bits=63)
         one = jnp.broadcast_to(jnp.asarray(F12.one()), m.shape)
         mk = mask[:, None, None, None]
         m = jnp.where(mk, m, one)

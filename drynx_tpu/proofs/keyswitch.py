@@ -54,9 +54,11 @@ class KeySwitchProofBatch:
     wire: Optional[dict] = None
 
     def wire_bytes(self) -> dict:
-        if self.wire is None:
-            self.wire = _wire_dict(self)
-        return self.wire
+        """Compute WITHOUT retaining on self: the batch travels as pickle,
+        and a cached byte dict would ship redundantly in every prover->VN
+        message (see create_keyswitch_proofs). A wire dict set explicitly
+        (e.g. by from-canonical-bytes decoding, if added) is still honored."""
+        return self.wire if self.wire is not None else _wire_dict(self)
 
     def to_bytes(self) -> bytes:
         ns, V = int(self.u_pts.shape[0]), int(self.u_pts.shape[1])

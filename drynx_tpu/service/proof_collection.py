@@ -217,29 +217,31 @@ class VerifyingNode:
         to_verify = [k for k in keys if pending[k][1]]
 
         def compute():
-            try:
-                with _VERIFY_DEVICE_LOCK:
-                    return joint([pending[k][0].data for k in to_verify],
-                                 req.survey_id)
-            except Exception:
-                # malformed payloads are FAILED verifications, not crashes
-                # (mirrors rq.verify_proof_request's containment)
-                import traceback
+            # exceptions PROPAGATE out of the cache (never memoized): a
+            # transient crash in one VN's flush must not poison every
+            # co-located VN's verdict for the process lifetime
+            with _VERIFY_DEVICE_LOCK:
+                return joint([pending[k][0].data for k in to_verify],
+                             req.survey_id)
 
-                log.warn(f"VN {self.name}: joint range verify raised: "
-                         f"{traceback.format_exc(limit=8)}")
-                return [False] * len(to_verify)
-
+        results: list = []
         if to_verify:
             import hashlib
 
             h = hashlib.sha256()
             for k in to_verify:
                 h.update(hashlib.sha256(pending[k][0].data).digest())
-            results = self.verify_cache.get_or_compute(
-                ("range_joint", req.survey_id, h.digest()), compute)
-        else:
-            results = []
+            try:
+                results = self.verify_cache.get_or_compute(
+                    ("range_joint", req.survey_id, h.digest()), compute)
+            except Exception:
+                # malformed payloads are FAILED verifications for THIS
+                # flush only (mirrors rq.verify_proof_request containment)
+                import traceback
+
+                log.warn(f"VN {self.name}: joint range verify raised: "
+                         f"{traceback.format_exc(limit=8)}")
+                results = [False] * len(to_verify)
         verdicts = dict(zip(to_verify, results))
         for k in keys:
             r, was_sampled, was_bad = pending[k]

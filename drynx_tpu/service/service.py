@@ -349,58 +349,24 @@ class LocalCluster:
     # per fresh process on this 1-core box — the round-2 bench timeouts).
     # Fusing each phase into ONE jitted program mirrors flagship
     # build_pipeline, which lowers+compiles in ~25 s.
+    #
+    # MODULE-LEVEL jits with the key tables as ARGUMENTS: per-instance jits
+    # (closures over each cluster's tables) re-compiled identical programs
+    # for every LocalCluster — a test suite churning clusters accumulated
+    # dozens of duplicate compiles until XLA's CPU compiler segfaulted
+    # (deterministically, at the same test). One jit per SHAPE per process.
     # ------------------------------------------------------------------
     def _fused(self):
-        fns = getattr(self, "_fused_fns", None)
-        if fns is not None:
-            return fns
-        import jax as _jax
-
-        from ..crypto import curve as Cv
-        from ..crypto import batching as Bt
-
-        base_tbl = eg.BASE_TABLE.table
         coll_tbl = self.coll_tbl.table
         q_tbl = self.client_tbl.table
 
-        @_jax.jit
         def enc(stats, enc_rs):
-            m = eg.int_to_scalar(stats)
-            return eg.encrypt_with_tables(base_tbl, coll_tbl, m, enc_rs)
+            return _fused_enc(coll_tbl, stats, enc_rs)
 
-        @_jax.jit
-        def agg_fn(cts):
-            return Bt.tree_reduce_add(cts, eg.ct_add)
-
-        @_jax.jit
         def ks(agg, ks_rs, srv_x, offset_total):
-            # key switch: per-server contributions + reduce (commuting sum
-            # replaces the CN chain — parallel/collective.py derivation)
-            K0 = agg[:, 0]
-            u_pts = eg.fixed_base_mul(base_tbl, ks_rs)      # (ns, V, 3, 16)
-            rQ = eg.fixed_base_mul(q_tbl, ks_rs)
-            xK = Cv.scalar_mul(K0[None], srv_x[:, None, :])
-            w_pts = Cv.add(rQ, Cv.neg(xK))
-            k_sum = Bt.tree_reduce_add(u_pts, Cv.add)
-            c_sum = Bt.tree_reduce_add(w_pts, Cv.add)
-            c2 = Cv.add(agg[:, 1], c_sum)
-            # signed-offset correction; offset 0 gives 0*B = infinity which
-            # is the group identity, so the same program serves both cases
-            corr = eg.fixed_base_mul(
-                base_tbl, eg.int_to_scalar(offset_total[None]))
-            c2 = Cv.add(c2, Cv.neg(jnp.broadcast_to(corr[0], c2.shape)))
-            switched = jnp.stack([k_sum, c2], axis=-3)
-            return switched, u_pts, w_pts
+            return _fused_ks(q_tbl, agg, ks_rs, srv_x, offset_total)
 
-        @_jax.jit
-        def dec(switched, qx, keys, xs, ysign, vals):
-            pts = eg.decrypt_point(switched, qx)
-            dvals, found = eg._table_lookup(keys, xs, ysign, vals, pts)
-            zeros = Cv.is_infinity(pts)
-            return dvals, found, zeros
-
-        fns = self._fused_fns = (enc, agg_fn, ks, dec)
-        return fns
+        return enc, _fused_agg, ks, _fused_dec
 
     @staticmethod
     def _ranges_per_value(q) -> list:
@@ -479,9 +445,10 @@ class LocalCluster:
         cts = f_enc(jnp.asarray(dp_stats), enc_rs)          # (n_dps, V, 2,3,16)
         cts.block_until_ready()
         if self.link.active:
-            # one DP->CN upload per DP: V ciphertexts of 128 canonical bytes
-            for _ in self.dp_idents:
-                self.link.charge(V * 128)
+            # DP->CN uploads ride INDEPENDENT links in parallel (the
+            # reference's per-link model): wall time = max over links =
+            # one delay + one payload serialization (V cts x 128 B)
+            self.link.charge(V * 128)
         tm.end("DataCollectionProtocol")
 
         if proofs_on:
@@ -707,9 +674,9 @@ class LocalCluster:
                     ptype, survey.sq.survey_id, ident.name,
                     f"{ptype}-{ident.name}", 0, data, ident.secret)
                 if self.link.active:
-                    # star fan-out: one prover->VN message per VN
-                    for _ in self.vns.vns:
-                        self.link.charge(len(data))
+                    # star fan-out to the VNs on parallel links: wall time
+                    # = one per-link delay + one payload serialization
+                    self.link.charge(len(data))
                 with lock:
                     self.vns.deliver(req)
             except BaseException:
@@ -728,6 +695,47 @@ class LocalCluster:
         t = threading.Thread(target=work, daemon=True)
         t.start()
         survey.proof_threads.append(t)
+
+
+@jax.jit
+def _fused_enc(coll_tbl, stats, enc_rs):
+    m = eg.int_to_scalar(stats)
+    return eg.encrypt_with_tables(eg.BASE_TABLE.table, coll_tbl, m, enc_rs)
+
+
+@jax.jit
+def _fused_agg(cts):
+    return B.tree_reduce_add(cts, eg.ct_add)
+
+
+@jax.jit
+def _fused_ks(q_tbl, agg, ks_rs, srv_x, offset_total):
+    # key switch: per-server contributions + reduce (commuting sum
+    # replaces the CN chain — parallel/collective.py derivation)
+    base_tbl = eg.BASE_TABLE.table
+    K0 = agg[:, 0]
+    u_pts = eg.fixed_base_mul(base_tbl, ks_rs)      # (ns, V, 3, 16)
+    rQ = eg.fixed_base_mul(q_tbl, ks_rs)
+    xK = C.scalar_mul(K0[None], srv_x[:, None, :])
+    w_pts = C.add(rQ, C.neg(xK))
+    k_sum = B.tree_reduce_add(u_pts, C.add)
+    c_sum = B.tree_reduce_add(w_pts, C.add)
+    c2 = C.add(agg[:, 1], c_sum)
+    # signed-offset correction; offset 0 gives 0*B = infinity which
+    # is the group identity, so the same program serves both cases
+    corr = eg.fixed_base_mul(
+        base_tbl, eg.int_to_scalar(offset_total[None]))
+    c2 = C.add(c2, C.neg(jnp.broadcast_to(corr[0], c2.shape)))
+    switched = jnp.stack([k_sum, c2], axis=-3)
+    return switched, u_pts, w_pts
+
+
+@jax.jit
+def _fused_dec(switched, qx, keys, xs, ysign, vals):
+    pts = eg.decrypt_point(switched, qx)
+    dvals, found = eg._table_lookup(keys, xs, ysign, vals, pts)
+    zeros = C.is_infinity(pts)
+    return dvals, found, zeros
 
 
 @dataclasses.dataclass

@@ -22,7 +22,9 @@ def main():
     args = ap.parse_args()
 
     if not args.tpu:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # FORCE cpu (not setdefault): the base env may carry an accelerator
+        # platform, and the grid is a CPU capture by default
+        os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_cpu_max_isa" not in flags:
             flags += " --xla_cpu_max_isa=AVX2"

@@ -234,17 +234,16 @@ def test_link_model_charges_wall_clock():
 
 @pytest.mark.slow
 def test_link_model_in_cluster_survey():
-    """A LocalCluster with a link model pays per-DP upload latency: the
-    DataCollection phase of a tiny no-proofs survey must include at least
-    n_dps * delay of modeled network time."""
+    """A LocalCluster with a link model pays the DP-upload link latency:
+    uploads ride INDEPENDENT links in parallel (the reference's per-link
+    model), so the DataCollection phase carries ONE delay + serialization,
+    regardless of roster size."""
     from drynx_tpu.service.service import LocalCluster
     from drynx_tpu.service.transport import LinkModel
 
-    n_dps = 4
-    cluster = LocalCluster(n_cns=2, n_dps=n_dps, n_vns=0, seed=3,
+    cluster = LocalCluster(n_cns=2, n_dps=4, n_vns=0, seed=3,
                            dlog_limit=2000, link=LinkModel(delay_ms=50))
     sq = cluster.generate_survey_query("sum", query_min=0, query_max=10)
     res = cluster.run_survey(sq)
-    assert res.timers.items()
     phases = dict(res.timers.items())
-    assert phases["DataCollectionProtocol"] >= n_dps * 0.05
+    assert phases["DataCollectionProtocol"] >= 0.05
