@@ -75,10 +75,13 @@ def test_fixed_base_kernel_matches_jnp():
     assert C.to_ref(out_pallas[1]) == refimpl.g1_mul(refimpl.G1, ss[1])
 
 
+@heavy
 def test_fixed_base_ladder_small_always_on():
-    """Always-on slice of the ladder kernel: n_windows=2 (k < 16^2) keeps the
-    interpret compile quick while still exercising the digit-decompose /
-    table-select / padd loop that the heavy tests cover in full."""
+    """Formerly always-on slice of the ladder kernel (n_windows=2): measured
+    in round 4, even this truncated interpret compile runs tens of minutes
+    on this box under jax 0.8, so it joins the opt-in interpret tier — the
+    kernels are validated on hardware (scripts/pallas_probe.py) and the
+    digit/table/padd logic is oracle-tested at the jnp layer."""
     ss = [0, 1, 200]  # infinity edge + generator + 2-digit scalar
     k = jnp.asarray(F.from_int(ss))
     out_pallas = po.fixed_base_mul_flat(eg.BASE_TABLE.table, k, n_windows=2)
@@ -87,6 +90,7 @@ def test_fixed_base_ladder_small_always_on():
     assert C.to_ref(out_pallas[2]) == refimpl.g1_mul(refimpl.G1, 200)
 
 
+@heavy
 def test_point_add_and_reduce_kernels():
     n = 3
     p, _ = _rand_points(n)
