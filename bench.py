@@ -41,6 +41,16 @@ BASELINE_PROOFS_S = 12.2
 BASELINE_EXEC_S = 1.32
 RANGES = (16, 5)     # reference simulation preset 18 (drynx_simul.go case 18)
 
+# --no-verify-cache: the UNDEDUPED control run (round-4 VERDICT task 10).
+# The default headline lets co-located VNs share one VerifyCache — identical
+# payloads verify once per process, matching the reference's
+# parallel-machines accounting (each of its VNs verifies on its own box
+# simultaneously; dedup factor: 9 keyswitch verifies -> 1, 3 joint-range ->
+# 1). This flag DISABLES the cache (VerifyCache maxsize=0) so every
+# delivery recomputes — all 9 keyswitch verifies run — and the true
+# single-chip SERIAL cost of all verifications lands beside the headline.
+NO_DEDUP = "--no-verify-cache" in sys.argv
+
 _t0 = time.time()
 _JSON_DONE = False
 
@@ -79,29 +89,45 @@ signal.signal(signal.SIGTERM, _signal_exit)
 signal.signal(signal.SIGINT, _signal_exit)
 
 
-def probe_backend(max_tries: int = 4) -> bool:
-    """Pre-flight the JAX backend in a SUBPROCESS with retry/backoff: the
+def probe_backend(max_tries: int = 2, attempt_timeout: float = 300.0,
+                  total_budget: float = 620.0) -> bool:
+    """Pre-flight the JAX backend in a SUBPROCESS with bounded retry: the
     r03 record died on an init-time 'UNAVAILABLE' raised by the first
     in-process dispatch — before any try/except could save the JSON.
     Probing out-of-process keeps a poisoned backend-init state out of this
-    process and lets a transiently-unavailable chip recover."""
+    process and lets a transiently-unavailable chip recover.
+
+    The TOTAL probe wall time is hard-capped (round-4 VERDICT weak #1: the
+    old 4x600s budget outlived the driver's ~30 min SIGTERM, so a down
+    tunnel recorded `bench_interrupted_before_headline` instead of the
+    honest `bench_failed_tpu_unavailable`). 2x300s + one short backoff
+    stays well inside any plausible driver window, and per-attempt elapsed
+    is logged so a 5-min-hanging jax.devices() is distinguishable from a
+    fast refusal."""
+    probe_t0 = time.time()
     for i in range(max_tries):
+        left = total_budget - (time.time() - probe_t0)
+        if left <= 5.0:
+            log(f"probe budget exhausted ({total_budget:.0f}s total cap)")
+            break
         t0 = time.time()
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; d=jax.devices(); print(d[0].platform)"],
-                capture_output=True, text=True, timeout=600)
+                capture_output=True, text=True,
+                timeout=min(attempt_timeout, left))
+            dt = time.time() - t0
             if r.returncode == 0:
-                log(f"backend probe ok in {time.time() - t0:.0f}s: "
-                    f"{r.stdout.strip()}")
+                log(f"backend probe ok in {dt:.0f}s: {r.stdout.strip()}")
                 return True
             log(f"backend probe attempt {i + 1}/{max_tries} rc={r.returncode}"
-                f": {r.stderr.strip()[-400:]}")
+                f" after {dt:.0f}s: {r.stderr.strip()[-400:]}")
         except subprocess.TimeoutExpired:
-            log(f"backend probe attempt {i + 1}/{max_tries} timed out")
+            log(f"backend probe attempt {i + 1}/{max_tries} timed out "
+                f"after {time.time() - t0:.0f}s")
         if i + 1 < max_tries:   # no pointless backoff after the last try
-            time.sleep(min(60.0, 10.0 * (2 ** i)))
+            time.sleep(10.0)
     return False
 
 
@@ -150,7 +176,8 @@ def _proofs_on_cluster():
     X, y, params = flagship.pima_shaped_problem(
         num_dps=num_dps, n_records=768, d=8, max_iterations=450)
     cluster = LocalCluster(n_cns=3, n_dps=num_dps, n_vns=3, seed=4,
-                           dlog_limit=10000)
+                           dlog_limit=10000,
+                           share_verify_cache=not NO_DEDUP)
     clear_stats = []
     for i, dp in enumerate(cluster.dps.values()):
         Xi, yi = lr.shard_for_dp(X, y, i, num_dps)
@@ -238,10 +265,15 @@ def main():
 
     # The deliverable: print NOW, before any bonus measurement can time out.
     emit({
-        "metric": "encrypted_logreg_pima_10dp_proofs_on_total_seconds",
+        "metric": "encrypted_logreg_pima_10dp_proofs_on_total_seconds"
+                  + ("_undeduped" if NO_DEDUP else ""),
         "value": round(dt, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_PROOFS_S / dt, 2),
+        # co-located VNs share one VerifyCache unless --no-verify-cache:
+        # 9 keyswitch verifies -> 1 compute, 3 joint-range -> 1 (the
+        # reference's VNs do this same work in PARALLEL on separate boxes)
+        "vn_verify_dedup": not NO_DEDUP,
     })
     log(f"headline recorded: proofs-on {dt:.4f}s = "
         f"{BASELINE_PROOFS_S / dt:.1f}x vs the 12.2s proofs-on baseline")

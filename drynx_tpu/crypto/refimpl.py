@@ -426,6 +426,30 @@ def pair(p1, q2):
     return final_exp(ate_miller_loop(p1, q2))
 
 
+def gphi12_cofactor_element(q: int = 13):
+    """An order-q root of unity in GΦ12's COFACTOR subgroup — the element a
+    commit-first RLC forger would inject (q must divide Φ12(p)/n; 13 and
+    2749 do for this curve). It passes cyclotomic membership
+    (batching.gt_membership_ok) but must fail the order-n gate
+    (batching.gt_order_ok); both gate tests derive their adversarial input
+    from THIS one construction so the curve fact lives in one place."""
+    from . import params
+
+    P_, N_ = params.P, params.N
+    phi12 = P_**4 - P_**2 + 1
+    assert phi12 % N_ == 0 and (phi12 // N_) % q == 0, \
+        f"{q} does not divide the GΦ12 cofactor"
+    for seed in (3, 5, 7):
+        x = tuple((pow(seed, k + 2, P_), pow(seed + 1, k + 3, P_))
+                  for k in range(6))
+        g = fp12_pow(x, (P_**12 - 1) // phi12)    # project into GΦ12
+        cand = fp12_pow(g, phi12 // q)            # kill the order-n part
+        if cand != FP12_ONE:
+            assert fp12_pow(cand, q) == FP12_ONE
+            return cand
+    raise AssertionError(f"no order-{q} element found (prob (1/{q})^3)")
+
+
 __all__ = [
     "fp_inv", "fp_sqrt",
     "fp2_add", "fp2_sub", "fp2_neg", "fp2_mul", "fp2_muls", "fp2_sq",
@@ -436,4 +460,5 @@ __all__ = [
     "g2_is_on_curve", "g2_neg", "g2_add", "g2_mul", "G2",
     "untwist", "miller_loop", "final_exp", "pair", "pair_tate",
     "ate_miller_loop", "twist_frob", "ATE_LOOP",
+    "gphi12_cofactor_element",
 ]

@@ -28,9 +28,6 @@ from ..crypto import elgamal as eg
 from . import encoding as enc
 
 
-from typing import Optional
-
-
 @dataclasses.dataclass
 class KeySwitchProofBatch:
     """(ns, V) key-switch contribution proofs."""
@@ -46,24 +43,16 @@ class KeySwitchProofBatch:
     challenge: jnp.ndarray  # (ns, V, 16)
     zr: jnp.ndarray       # (ns, V, 16)
     zx: jnp.ndarray       # (ns, V, 16)
-    # canonical-byte cache of every hashed tensor (same contract as
-    # RangeProofBatch.wire: MUST match the tensors when set — creation
-    # fills it; code building modified batches must pass wire=None).
-    # Saves the verifier the 8 normalize+from_mont device passes of the
-    # challenge recompute (pure host hashing instead).
-    wire: Optional[dict] = None
-
-    def wire_bytes(self) -> dict:
-        """Compute WITHOUT retaining on self: the batch travels as pickle,
-        and a cached byte dict would ship redundantly in every prover->VN
-        message (see create_keyswitch_proofs). A wire dict set explicitly
-        (e.g. by from-canonical-bytes decoding, if added) is still honored."""
-        return self.wire if self.wire is not None else _wire_dict(self)
+    # NOTE: unlike RangeProofBatch there is deliberately NO wire-byte cache
+    # field — the batch travels as pickle, where a cached dict would be
+    # attacker-controlled (bytes disagreeing with the tensors) and would
+    # bloat every prover->VN message. Everything that needs the canonical
+    # encoding re-derives it from the tensors via _wire_dict.
 
     def to_bytes(self) -> bytes:
         ns, V = int(self.u_pts.shape[0]), int(self.u_pts.shape[1])
         head = np.asarray([ns, V], dtype="<i8").tobytes()
-        w = self.wire_bytes()
+        w = _wire_dict(self)
         parts = [w["k"], w["u"], w["w"], w["ys"], w["q"], w["a1"], w["a2"],
                  w["a3"],
                  enc.scalar_bytes(self.challenge), enc.scalar_bytes(self.zr),
@@ -74,7 +63,7 @@ class KeySwitchProofBatch:
 
 def _wire_dict(pb: "KeySwitchProofBatch") -> dict:
     """THE one definition of the canonical transcript encoding — creation,
-    wire_bytes and verification all call this so the Fiat-Shamir hash can
+    to_bytes and verification all call this so the Fiat-Shamir hash can
     never desynchronize between them."""
     return {"k": enc.g1_bytes(pb.orig_k), "u": enc.g1_bytes(pb.u_pts),
             "w": enc.g1_bytes(pb.w_pts), "ys": enc.g1_bytes(pb.ys),
@@ -129,10 +118,8 @@ def create_keyswitch_proofs(key, orig_k, srv_x, ks_rs, q_pt, q_tbl,
     a1, a2, a3 = _commit_kernel(orig_k, q_tbl, wr, wx)
     base = eg.BASE_TABLE.table
     ys = eg.fixed_base_mul(base, jnp.asarray(srv_x))
-    # build the batch FIRST, then hash via the shared _wire_dict; the wire
-    # cache is deliberately NOT retained on the returned object — the
-    # payload travels as pickle and the dead bytes would bloat every
-    # prover->VN message and ProofDB entry (the verifier re-encodes anyway)
+    # build the batch FIRST, then hash via the shared _wire_dict (computed
+    # transiently — see the no-cache NOTE on the dataclass)
     pb = KeySwitchProofBatch(orig_k=jnp.asarray(orig_k), u_pts=u_pts,
                              w_pts=w_pts, ys=ys, q_pt=jnp.asarray(q_pt),
                              a1=a1, a2=a2, a3=a3,
@@ -164,12 +151,10 @@ def _verify_kernel(orig_k, u_pts, w_pts, ys, q_tbl, a1, a2, a3, c, zr, zx):
 def verify_keyswitch_proofs(proof: KeySwitchProofBatch, q_tbl) -> np.ndarray:
     """Returns bool (ns, V); recomputes the challenge.
 
-    Deliberately IGNORES any attached wire-byte cache: this batch travels
-    as a pickle, so a malicious sender could ship a cache that disagrees
-    with the tensors — hashing it would let them fix c first and derive
-    a1/a2/a3 post-hoc. The verifier re-encodes the tensors it actually
-    checks. (RangeProofBatch CAN trust its cache: from_bytes derives
-    tensors and cache from one buffer.)"""
+    Re-encodes the hashed tensors itself (_wire_dict) — there is no wire
+    cache on this batch to trust; see the dataclass NOTE. (RangeProofBatch
+    CAN trust its cache: from_bytes derives tensors and cache from one
+    buffer.)"""
     ok = np.asarray(_verify_kernel(
         proof.orig_k, proof.u_pts, proof.w_pts, proof.ys, q_tbl, proof.a1,
         proof.a2, proof.a3, proof.challenge, proof.zr, proof.zx))

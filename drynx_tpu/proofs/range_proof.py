@@ -741,14 +741,18 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     A forged transcript has some f_ij != 1, and the check passes only if
     sum_ij r_ij * x_ij = 0 in the exponent lattice (x_ij = dlog of f_ij in
     the subgroup it generates): probability <= 2^-62 per independent r,
-    unless f_ij has small order d (then 1/d). Making f_ij small-order,
-    however, requires a = conj6(eps * v(H(...||a))^-1) for a d-th root of
-    unity eps — a fixed point of sha3-512, since a is an input of the hash
-    that determines c and hence v. Without the challenge binding (round-2
-    state) the adversary could choose a freely AFTER c and hit eps = -1
-    with probability 1/2 per attempt — that attack now fails
-    deterministically at the challenge recompute (regression-tested by
-    test_rlc_small_order_forgery_rejected).
+    unless f_ij has small order d (then 1/d). Two small-order routes are
+    closed separately: (1) choosing a AFTER c (round-2 state) fails
+    deterministically at the challenge recompute, since a is hashed into c
+    (regression-tested by test_rlc_small_order_forgery_rejected); (2) a
+    COMMIT-FIRST forger who sets a' = a_honest * eps BEFORE hashing, with
+    eps a root of unity in GΦ12's cofactor subgroup (this curve's cofactor
+    is divisible by 13 and 2749, so eps of order 13 exists), passes the
+    challenge binding and the D equation and would survive the draw with
+    probability 1/13 — that route is killed by rlc_prelude's order-n gate
+    gt_order_ok (a^n == 1 via frob1(a) == a^(t-1)), which forces every
+    wire a into the order-n subgroup where the only subgroup orders are 1
+    and n (regression-tested by test_rlc_cofactor_forgery_rejected).
 
     The D-equation and Fiat-Shamir challenge are still checked per value
     (cheap G1 work). Returns one bool for the batch.
@@ -807,6 +811,11 @@ def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
       * binding Fiat-Shamir challenge recompute over D ‖ V ‖ a
       * GΦ12 membership of every wire-provided a (gt_membership_ok —
         required before the cyclotomic-squaring pow chains touch them)
+      * order-n membership of every a (gt_order_ok, frob1(a) == a^(t-1)):
+        GΦ12 alone leaves the cofactor subgroup open, and this curve's
+        cofactor is divisible by 13 — a commit-first forger injecting a
+        13th root of unity into a would otherwise survive the RLC draw
+        with probability 1/13 (round-4 advisor finding)
       * verifier-secret 62-bit RLC weights r
       * [with_gtb_pow] gtB^(sum_ij r_ij*Zv_ij), the one fixed-base power
 
@@ -827,7 +836,7 @@ def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     ok = bool(np.all(np.asarray(B.g1_eq(Dp, proof.d))))
     if check_challenge:
         ok = ok and bool(np.all(_challenge_ok(proof, sigs_pub)))
-    ok = ok and B.gt_membership_ok(proof.a)
+    ok = ok and B.gt_membership_ok(proof.a) and B.gt_order_ok(proof.a)
 
     if rng is None:
         rng = np.random.default_rng(

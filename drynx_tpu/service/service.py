@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import secrets
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -112,7 +113,7 @@ class LocalCluster:
 
     def __init__(self, n_cns: int = 3, n_dps: int = 5, n_vns: int = 3,
                  seed: int = 1, dlog_limit: int = 10000,
-                 link=None):
+                 link=None, share_verify_cache: bool = True):
         # link: an optional transport.LinkModel; when active, the in-process
         # cluster sleeps at every boundary where the reference pays a real
         # network message (DP ciphertext upload, proof delivery to each VN),
@@ -155,12 +156,20 @@ class LocalCluster:
             # payloads (e.g. the keyswitch batch every CN relays, or the
             # joint range flush) verify once per process — real VNs on
             # separate machines do this same work in parallel, so the
-            # single-chip wall time stays comparable (see VerifyCache)
+            # single-chip wall time stays comparable (see VerifyCache,
+            # including its soundness caveat: shared cache = one RLC weight
+            # draw per process). share_verify_cache=False DISABLES caching
+            # entirely (maxsize=0: every delivery recomputes, so the 9
+            # keyswitch deliveries cost 9 verifies, not 1 or 3) — the
+            # undeduped control configuration bench.py --no-verify-cache
+            # records next to the headline.
             shared_cache = VerifyCache()
             self.vns = VNGroup([
                 VerifyingNode(v.name, f"{self._vn_dir}/{v.name}.db", pubs,
                               verify_fns=self._verify_fns(), seed=i,
-                              verify_cache=shared_cache)
+                              verify_cache=(shared_cache
+                                            if share_verify_cache
+                                            else VerifyCache(maxsize=0)))
                 for i, v in enumerate(self.vn_idents)])
 
         self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
@@ -222,9 +231,31 @@ class LocalCluster:
                 proof, jnp.asarray(in_cts), jnp.asarray(out_cts),
                 jnp.asarray(C.from_ref(self.coll_pub)))
 
-        return {"range": vrange, "range_joint": vrange_joint,
-                "aggregation": vagg, "obfuscation": vobf,
-                "keyswitch": vks, "shuffle": vshuffle}
+        # Phase attribution (reference CSV taxonomy, parse_time_data_test.go
+        # flags): each payload verification lands in its Verify<Type> column
+        # AND in AllProofs (with creation time, added by _async_proof), so
+        # proof cost no longer hides inside JustExecution (round-4 VERDICT
+        # missing #4). Cache HITS add nothing — only computed verifications
+        # count, matching "time the process spent verifying".
+        def _timed(name, fn):
+            def wrapped(data, sid, _fn=fn, _name=name):
+                t0 = time.perf_counter()
+                try:
+                    return _fn(data, sid)
+                finally:
+                    sv = self.surveys.get(sid)
+                    if sv is not None:
+                        dt = time.perf_counter() - t0
+                        sv.timers.add(_name, dt)
+                        sv.timers.add("AllProofs", dt)
+            return wrapped
+
+        return {"range": _timed("VerifyRange", vrange),
+                "range_joint": _timed("VerifyRange", vrange_joint),
+                "aggregation": _timed("VerifyAggregation", vagg),
+                "obfuscation": _timed("VerifyObfuscation", vobf),
+                "keyswitch": _timed("VerifyKeySwitch", vks),
+                "shuffle": _timed("VerifyShuffle", vshuffle)}
 
     # ------------------------------------------------------------------
     # Survey query construction (reference API.GenerateSurveyQuery, api.go:58)
@@ -669,7 +700,12 @@ class LocalCluster:
         def work():
             try:
                 with lock:
+                    t0 = time.perf_counter()
                     data = build()
+                    # creation cost -> AllProofs (the reference's creation
+                    # runs inside its phase timers; ours runs here)
+                    survey.timers.add("AllProofs",
+                                      time.perf_counter() - t0)
                 req = rq.new_proof_request(
                     ptype, survey.sq.survey_id, ident.name,
                     f"{ptype}-{ident.name}", 0, data, ident.secret)

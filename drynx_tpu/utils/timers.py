@@ -48,6 +48,19 @@ class PhaseTimers:
                   flush=True)
         return dt
 
+    def add(self, name: str, dt: float) -> None:
+        """Accumulate an externally-measured span. Unlike start/end this is
+        safe under arbitrary thread overlap (no shared open-slot state) —
+        it is how the concurrent proof creation/verification paths attribute
+        their time (service.py: AllProofs / Verify<Type>)."""
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+        if PhaseTimers.echo:
+            import sys
+
+            print(f"    [phase] {name}: +{dt:.3f}s", file=sys.stderr,
+                  flush=True)
+
     def __getitem__(self, name: str) -> float:
         return self._acc.get(name, 0.0)
 

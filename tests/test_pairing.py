@@ -124,6 +124,32 @@ def test_gt_membership_gate():
     assert not B.gt_membership_ok(both)
 
 
+def test_gt_order_gate():
+    """Order-n gate (batching.gt_order_ok): honest pairing outputs pass; a
+    cofactor root of unity (order 13 — 13 divides Φ12(p)/n for this curve)
+    passes the GΦ12 membership gate but MUST fail the order gate, since it
+    is exactly the element a commit-first RLC forger would inject."""
+    import numpy as np
+
+    from drynx_tpu.crypto import batching as B
+    from drynx_tpu.crypto import fp12 as F12
+    from drynx_tpu.crypto import params
+
+    f = jnp.asarray(F12.from_ref(refimpl.pair(refimpl.G1, refimpl.G2)))
+    assert B.gt_order_ok(f[None])
+
+    # 13 divides Φ12(p)/n for this curve — asserted inside the helper
+    eps = refimpl.gphi12_cofactor_element(13)
+    eps_d = jnp.asarray(F12.from_ref(eps))
+    assert B.gt_membership_ok(eps_d[None])     # inside GΦ12 ...
+    assert not B.gt_order_ok(eps_d[None])      # ... outside order-n GT
+    # a tampered honest element and a mixed batch also fail
+    bad = jnp.asarray(F12.from_ref(refimpl.fp12_mul(
+        refimpl.pair(refimpl.G1, refimpl.G2), eps)))
+    assert not B.gt_order_ok(bad[None])
+    assert not B.gt_order_ok(jnp.stack([f, bad]))
+
+
 def test_host_oracle_final_exp_fast_parity():
     """host_oracle.final_exp_fast (easy + Olivos hard part on ints) must be
     bit-identical to refimpl.final_exp (the naive full exponentiation) on

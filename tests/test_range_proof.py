@@ -224,6 +224,88 @@ def test_rlc_small_order_forgery_rejected(setup):
             f"small-order forgery accepted with rng seed {seed}"
 
 
+class _FixedRng:
+    """Deterministic stand-in for rlc_prelude's weight draw: weight
+    [0, 0, 0] is fixed, all others 1 — isolates the tampered factor."""
+
+    def __init__(self, r0):
+        self.r0 = r0
+
+    def integers(self, lo, hi, size=None, dtype=None):
+        r = np.full(size, 1, dtype=dtype)
+        r[0, 0, 0] = self.r0
+        return r
+
+
+def test_rlc_cofactor_forgery_rejected(setup):
+    """Round-4 advisor finding (medium): GΦ12 has order n·c with 13 | c, so
+    a COMMIT-FIRST forger can set a' = a_honest·eps (eps of order 13)
+    BEFORE the Fiat-Shamir hash — the challenge binding, the D equation,
+    and the GΦ12 membership gate all pass, and the RLC draw then accepts
+    whenever 13 | r for the tampered weight (probability 1/13 per draw).
+    rlc_prelude's order-n gate (gt_order_ok) must reject it for every
+    draw."""
+    from drynx_tpu.crypto import batching as B
+    from drynx_tpu.crypto import host_oracle as ho
+
+    sigs, _, _, ca_tbl = setup
+    pubs = [s.public for s in sigs]
+    values = np.asarray([5], dtype=np.int64)
+    cts, rs = eg.encrypt_ints(jax.random.PRNGKey(61), ca_tbl, values)
+    eps = refimpl.gphi12_cofactor_element(13)
+
+    # commit-first forgery: honest commit stage, tamper a BEFORE hashing,
+    # then compute honest responses from the tampered-transcript challenge
+    ns, V = len(sigs), 1
+    digits = jnp.asarray(rp.to_base(values, U, L))
+    ks = jax.random.split(jax.random.PRNGKey(62), 4)
+    s = eg.random_scalars(ks[0], (V, L))
+    t = eg.random_scalars(ks[1], (V, L))
+    m = eg.random_scalars(ks[2], (V, L))
+    v = eg.random_scalars(ks[3], (ns, V, L))
+    A_tab = jnp.asarray(np.stack([sg.A for sg in sigs]))
+    D, m_tot, V_pts, a = rp._commit_kernel(
+        digits, s, t, m, v, A_tab, ca_tbl.table, U, L,
+        gtA=rp.sig_gt_table(sigs))
+    a = np.asarray(a).copy()
+    a[0, 0, 0] = ho.gt_mul_host(a[0, 0, 0][None],
+                                ho._fp12_from_ref(eps)[None])[0]
+    a = jnp.asarray(a)
+    wire = rp._range_wire_dict(cts, D, V_pts, a)
+    c = jnp.asarray(rp.challenge_from_wire(
+        wire, rp.sum_publics_bytes(sigs), U, L))
+    zphi, zr, zv = rp._response_kernel(digits, c, jnp.asarray(rs), s, t,
+                                       m_tot, v)
+    forged = rp.RangeProofBatch(commit=jnp.asarray(cts), challenge=c,
+                                zr=zr, d=D, zphi=zphi, zv=zv, v_pts=V_pts,
+                                a=a, u=U, l=L, wire=wire)
+
+    # the attack is faithfully emulated: binding + GΦ12 both pass ...
+    assert bool(np.all(rp._challenge_ok(forged, pubs)))
+    assert B.gt_membership_ok(forged.a)
+    # ... and WITHOUT the order gate, a 13-divisible weight draw accepts
+    # while a non-divisible one rejects — exactly the 1/13 exposure
+    orig = B.gt_order_ok
+    try:
+        B.gt_order_ok = lambda _a: True
+        assert rp.verify_range_proofs_batch(
+            forged, pubs, ca_tbl.table, rng=_FixedRng(13)), \
+            "forgery construction broken: 13|r draw should accept ungated"
+        assert not rp.verify_range_proofs_batch(
+            forged, pubs, ca_tbl.table, rng=_FixedRng(7))
+    finally:
+        B.gt_order_ok = orig
+    # the order-n gate rejects it regardless of the draw
+    assert not B.gt_order_ok(forged.a)
+    assert not rp.verify_range_proofs_batch(
+        forged, pubs, ca_tbl.table, rng=_FixedRng(13))
+    # and honest proofs still pass the gate end-to-end
+    honest = rp.create_range_proofs(
+        jax.random.PRNGKey(63), values, rs, cts, sigs, U, L, ca_tbl.table)
+    assert rp.verify_range_proofs_batch(
+        honest, pubs, ca_tbl.table, rng=np.random.default_rng(1))
+
+
 def test_sig_gt_pow_tables_entries(setup):
     """Per-base GT window tables (creation's squaring-free digit pow):
     T[b][w][j] must equal gtA_b^(j * 16^w) — checked against the oracle on
