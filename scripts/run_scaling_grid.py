@@ -48,14 +48,16 @@ def main():
         f.write(csv)
 
     # one markdown row per grid run, aligned on the phase taxonomy
-    lines = ["| op | cns | dps | vns | " +
+    lines = ["| op | cns | dps | vns | rows | bitmap | " +
              " | ".join(p for p in timedata.PHASES) + " |",
-             "|" + "---|" * (4 + len(timedata.PHASES))]
+             "|" + "---|" * (6 + len(timedata.PHASES))]
     for r in results:
         c, t = r["config"], r["timings"]
+        bm = r.get("bitmap_codes") or {}
+        bm_s = ",".join(f"{k}:{v}" for k, v in sorted(bm.items())) or "-"
         lines.append(
             f"| {c['operation']} | {c['nbr_servers']} | {c['nbr_dps']} | "
-            f"{c['nbr_vns']} | " +
+            f"{c['nbr_vns']} | {c['rows_per_dp']} | {bm_s} | " +
             " | ".join(f"{t.get(p, 0.0):.3f}" for p in timedata.PHASES) +
             " |")
     table = "\n".join(lines) + "\n"
