@@ -319,7 +319,9 @@ def gt_order_ok(a) -> bool:
 
         for i in range(flat.shape[0]):
             f = _fp12_to_ref(flat[i])
-            if _fp12_frob(f, 1) != refimpl.fp12_pow(f, t1):
+            # cyclotomic squarings are valid here: the caller contract
+            # (gt_membership_ok first) puts f in GΦ12
+            if _fp12_frob(f, 1) != refimpl.fp12_cyc_pow(f, t1):
                 return False
         return True
     flat = jnp.asarray(a).reshape(-1, 6, 2, params.NUM_LIMBS)

@@ -157,6 +157,49 @@ def fp12_pow(a, e):
     return r
 
 
+def fp12_csqr(f):
+    """Granger-Scott cyclotomic squaring (eprint 2009/565 §3.2) on the flat
+    tower — the INT twin of the Mosaic kernel's formulas (pallas_pairing
+    make_fp12 f12csqr; parity asserted in tests/test_pairing.py). Valid
+    ONLY for f in GΦ12(p); 9 Fp2 squarings vs fp12_sq's 36 Fp2 mults, so
+    the host-oracle order-n gate pow halves its squaring bill."""
+    f0, f1, f2, f3, f4, f5 = f
+    t0 = fp2_sq(f3)
+    t1 = fp2_sq(f0)
+    t6 = fp2_sub(fp2_sub(fp2_sq(fp2_add(f3, f0)), t0), t1)
+    t2 = fp2_sq(f4)
+    t3 = fp2_sq(f1)
+    t7 = fp2_sub(fp2_sub(fp2_sq(fp2_add(f4, f1)), t2), t3)
+    t4 = fp2_sq(f5)
+    t5 = fp2_sq(f2)
+    t8 = fp2_mul(fp2_sub(fp2_sub(fp2_sq(fp2_add(f5, f2)), t4), t5), XI)
+    t0 = fp2_add(fp2_mul(t0, XI), t1)
+    t2 = fp2_add(fp2_mul(t2, XI), t3)
+    t4 = fp2_add(fp2_mul(t4, XI), t5)
+
+    def out_sub(t, x):            # 3t - 2x
+        d = fp2_sub(t, x)
+        return fp2_add(fp2_add(d, d), t)
+
+    def out_add(t, x):            # 3t + 2x
+        s = fp2_add(t, x)
+        return fp2_add(fp2_add(s, s), t)
+
+    return (out_sub(t0, f0), out_add(t8, f1), out_sub(t2, f2),
+            out_add(t6, f3), out_sub(t4, f4), out_add(t7, f5))
+
+
+def fp12_cyc_pow(f, e):
+    """f^e via cyclotomic squarings — REQUIRES f in GΦ12 (callers gate)."""
+    r = FP12_ONE
+    while e:
+        if e & 1:
+            r = fp12_mul(r, f)
+        f = fp12_csqr(f)
+        e >>= 1
+    return r
+
+
 def fp12_conj6(a):
     """a^(p^6): conjugation w -> -w (negate odd coefficients)."""
     return tuple(fp2_neg(c) if k % 2 else c for k, c in enumerate(a))
@@ -454,7 +497,8 @@ __all__ = [
     "fp_inv", "fp_sqrt",
     "fp2_add", "fp2_sub", "fp2_neg", "fp2_mul", "fp2_muls", "fp2_sq",
     "fp2_inv", "fp2_pow", "fp2_sqrt", "FP2_ZERO", "FP2_ONE", "B2",
-    "fp12_mul", "fp12_sq", "fp12_pow", "fp12_conj6", "fp12_inv",
+    "fp12_mul", "fp12_sq", "fp12_pow", "fp12_csqr", "fp12_cyc_pow",
+    "fp12_conj6", "fp12_inv",
     "FP12_ONE", "FP12_ZERO",
     "g1_is_on_curve", "g1_neg", "g1_add", "g1_mul", "G1",
     "g2_is_on_curve", "g2_neg", "g2_add", "g2_mul", "G2",

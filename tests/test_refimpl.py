@@ -57,3 +57,22 @@ def test_pairing_bilinear_nondegenerate():
     assert r.pair(r.g1_mul(r.G1, a), r.G2) == r.fp12_pow(e, a)
     assert r.pair(r.G1, r.g2_mul(r.G2, b)) == r.fp12_pow(e, b)
     assert r.pair(r.g1_mul(r.G1, a), r.g2_mul(r.G2, b)) == r.fp12_pow(e, a * b % params.N)
+
+
+def test_cyclotomic_squaring_matches_generic():
+    """fp12_csqr (Granger-Scott, the int twin of the Mosaic kernel's
+    formulas) must equal the generic square on GΦ12 members — it backs the
+    host-oracle order-n gate's pow (batching.gt_order_ok)."""
+    e = r.pair(r.G1, r.G2)
+    assert r.fp12_csqr(e) == r.fp12_sq(e)
+    # chain of 5 squarings stays exact
+    x = e
+    for _ in range(5):
+        x = r.fp12_csqr(x)
+    assert x == r.fp12_pow(e, 32)
+    # cyc pow with the gate's actual exponent t-1 = p - n
+    t1 = params.P - params.N
+    assert r.fp12_cyc_pow(e, t1) == r.fp12_pow(e, t1)
+    # and a cofactor element (also cyclotomic) squares correctly too
+    eps = r.gphi12_cofactor_element(13)
+    assert r.fp12_csqr(eps) == r.fp12_sq(eps)
