@@ -124,6 +124,9 @@ def main():
     pb = rp._concat_batches([b for lst in lists for _ia, b in lst.batches])
     stage("v1_prelude_D_chal_member", lambda: rp.rlc_prelude(
         pb, pubs[U], ca_tbl.table) and None)
+    # the round-5 soundness gates, isolated (also inside v1's total):
+    stage("v1a_membership_gate", lambda: B.gt_membership_ok(pb.a) and None)
+    stage("v1b_order_n_gate", lambda: B.gt_order_ok(pb.a) and None)
     pre_ok, r_int, gtb_pow_s = rp.rlc_prelude(pb, pubs[U], ca_tbl.table)
     r = B.int_to_scalar(jnp.asarray(r_int))
     ys = jnp.asarray(np.stack([C.from_ref(p) for p in pubs[U]]))
