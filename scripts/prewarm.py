@@ -25,7 +25,7 @@ def main():
 
     t0 = time.time()
     if args.cpu:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"  # FORCE (env may carry axon)
     import jax
 
     if args.cpu:

@@ -27,7 +27,7 @@ def main():
     args = ap.parse_args()
 
     if args.cpu:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"  # FORCE (env may carry axon)
     import jax
 
     if args.cpu:

@@ -47,7 +47,7 @@ def main():
                          "suite's setting)")
     args = ap.parse_args()
 
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"  # FORCE (env may carry axon)
     if args.opt_level_0:
         flags = os.environ.get("XLA_FLAGS", "")
         os.environ["XLA_FLAGS"] = (flags +
