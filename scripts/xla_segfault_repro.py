@@ -29,6 +29,17 @@ compiles. If this script exits 0 at several times that budget, the
 in-repo trigger involves program CONTENT (pairing-scale graphs), and the
 next repro step is replaying the suite's actual HLO dumps
 (XLA_FLAGS=--xla_dump_to=...) in a fresh process via jax.export.
+
+RESULTS so far (round 5, jax 0.9.0):
+  * 500 distinct 256-step scan compiles, default opt: NO repro (310 s).
+  * 250 distinct 2048-step scan compiles, opt-level 0: NO repro (47 s).
+Conclusion: generic scan-ladder accumulation does NOT trigger it at 3x
+the suite's compile count — the trigger involves the pairing-scale
+program content (deep fp12 expression trees), not compile COUNT alone.
+Next step for an upstream report: capture --xla_dump_to HLO from a
+crashing suite run and replay the dump sequence in a fresh process.
+The per-file isolation quarantine (pytest.ini) therefore stands, with
+this boundary documented.
 """
 import argparse
 import os
