@@ -312,9 +312,12 @@ def gt_order_ok(a) -> bool:
 
     t1 = params.P - params.N                             # t-1 = p - n
     if ho.ENABLED and not po.available():
+        from . import native_pairing as npair
         from . import refimpl
 
         flat = np.asarray(a).reshape(-1, 6, 2, params.NUM_LIMBS)
+        if npair.available():  # bit-identical C++ backend
+            return bool(np.all(npair.gt_order_check_batch(flat)))
         from .host_oracle import _fp12_frob, _fp12_to_ref
 
         for i in range(flat.shape[0]):

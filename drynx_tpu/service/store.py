@@ -34,10 +34,18 @@ def _load_lib():
             if (not os.path.exists(_LIB_PATH)
                     or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
                 os.makedirs(_LIB_DIR, exist_ok=True)
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", _LIB_PATH],
-                    check=True, capture_output=True)
+                # atomic install: parallel test processes may all build at
+                # once; never let one dlopen a half-written .so
+                tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                         _SRC, "-o", tmp],
+                        check=True, capture_output=True)
+                    os.replace(tmp, _LIB_PATH)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             lib = ctypes.CDLL(_LIB_PATH)
             lib.pdb_open.restype = ctypes.c_void_p
             lib.pdb_open.argtypes = [ctypes.c_char_p]
