@@ -60,7 +60,8 @@ def _load():
                 tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
                 try:
                     subprocess.run(
-                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                        ["g++", "-O3", "-march=native", "-funroll-loops",
+                         "-shared", "-fPIC", "-std=c++17",
                          _SRC, "-o", tmp],
                         check=True, capture_output=True, text=True)
                     os.replace(tmp, _LIB_PATH)
