@@ -99,11 +99,19 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
     return wrapped
 
 
-def host_dispatch(host_fn, tail_ranks, kernel_wrapped):
-    """Route a pairing-family op to the pure-Python oracle when Pallas is
-    unavailable (crypto/host_oracle.py — zero XLA compile, the round-3 CPU
-    compile bill was hours per process), else to the bucketed kernel. The
-    host path flattens/broadcasts all leading batch dims to one axis."""
+def host_dispatch(host_fn, tail_ranks, kernel_wrapped, gate=None):
+    """Route a crypto-family op to the host backend when Pallas is
+    unavailable (crypto/host_oracle.py -> the native C++ library or the
+    pure-Python oracle — zero XLA compile, the round-3 CPU compile bill
+    was hours per process), else to the bucketed kernel. The host path
+    flattens/broadcasts all leading batch dims to one axis.
+
+    tail_ranks: per-arg rank of the non-batch suffix; -1 passes the arg
+    through untouched (constant tables). gate: optional predicate checked
+    at call time — when false the kernel path is used (e.g. the G1 family
+    only detours to host when the NATIVE library built; the Python oracle
+    would lose to XLA there). Tuple-returning host fns are supported
+    (each element reshaped to the batch)."""
 
     def wrapped(*args):
         from . import host_oracle as ho
@@ -111,19 +119,29 @@ def host_dispatch(host_fn, tail_ranks, kernel_wrapped):
 
         if not (ho.ENABLED and not po.available()):
             return kernel_wrapped(*args)
+        if gate is not None and not gate():
+            return kernel_wrapped(*args)
         if any(isinstance(a, jax.core.Tracer) for a in args):
             # inside a jit/shard_map trace np.asarray would raise
             # TracerArrayConversionError — the kernel path traces fine
             return kernel_wrapped(*args)
-        arrs = [np.asarray(a) for a in args]
+        arrs = [a if r < 0 else np.asarray(a)
+                for a, r in zip(args, tail_ranks)]
         batch = jnp.broadcast_shapes(
-            *[a.shape[: a.ndim - r] for a, r in zip(arrs, tail_ranks)])
+            *[a.shape[: a.ndim - r] for a, r in zip(arrs, tail_ranks)
+              if r >= 0])
         flat = []
         for a, r in zip(arrs, tail_ranks):
+            if r < 0:
+                flat.append(a)
+                continue
             tail = a.shape[a.ndim - r:] if r else ()
             flat.append(np.ascontiguousarray(
                 np.broadcast_to(a, batch + tail)).reshape((-1,) + tail))
         out = host_fn(*flat)
+        if isinstance(out, tuple):
+            return tuple(jnp.asarray(o.reshape(batch + o.shape[1:]))
+                         for o in out)
         return jnp.asarray(out.reshape(batch + out.shape[1:]))
 
     return wrapped
@@ -158,19 +176,36 @@ def _build():
     from . import field as F
     from .field import FN
 
+    from . import host_oracle as _ho_early
+    from . import native_pairing as npair
+
     g = globals()
-    g["g1_add"] = bucketed(C.add, (2, 2), 2, max_bucket=4096)
-    g["g1_neg"] = bucketed(C.neg, (2,), 2, max_bucket=4096)
-    g["g1_scalar_mul"] = bucketed(C.scalar_mul, (2, 1), 2, max_bucket=4096)
-    g["g1_eq"] = bucketed(C.eq, (2, 2), 0, max_bucket=4096)
-    g["g1_normalize"] = bucketed(C.normalize, (2,), (1, 1, 0),
-                                 max_bucket=4096)
+    # G1 family: on CPU (no Pallas) detour to the native C++ library when
+    # it built — gated on npair.available because the PYTHON oracle would
+    # lose to the XLA kernels here, unlike the pairing family
+    _ng = npair.available
+    g["g1_add"] = host_dispatch(
+        _ho_early.g1_add_host, (2, 2),
+        bucketed(C.add, (2, 2), 2, max_bucket=4096), gate=_ng)
+    g["g1_neg"] = host_dispatch(
+        _ho_early.g1_neg_host, (2,),
+        bucketed(C.neg, (2,), 2, max_bucket=4096), gate=_ng)
+    g["g1_scalar_mul"] = host_dispatch(
+        _ho_early.g1_scalar_mul_host, (2, 1),
+        bucketed(C.scalar_mul, (2, 1), 2, max_bucket=4096), gate=_ng)
+    g["g1_eq"] = host_dispatch(
+        _ho_early.g1_eq_host, (2, 2),
+        bucketed(C.eq, (2, 2), 0, max_bucket=4096), gate=_ng)
+    g["g1_normalize"] = host_dispatch(
+        _ho_early.g1_normalize_host, (2,),
+        bucketed(C.normalize, (2,), (1, 1, 0), max_bucket=4096), gate=_ng)
     g["g2_scalar_mul"] = bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32,
                                   max_bucket=2048)
     g["g2_normalize"] = bucketed(G2.normalize, (3,), (2, 2, 0),
                                  min_bucket=32, max_bucket=2048)
-    g["fixed_base_mul"] = bucketed(eg.fixed_base_mul, (-1, 1), 2,
-                                   max_bucket=4096)
+    g["fixed_base_mul"] = host_dispatch(
+        _ho_early.fixed_base_mul_host, (-1, 1),
+        bucketed(eg.fixed_base_mul, (-1, 1), 2, max_bucket=4096), gate=_ng)
     from . import pallas_ops as po
     from . import pallas_pairing as ppair
 
@@ -242,9 +277,10 @@ def _build():
                              max_bucket=2048)
     g["gt_frob1"] = bucketed(_gt_frob1_fn, (3,), 3, min_bucket=32,
                              max_bucket=2048)
-    g["g1_scalar_mul64"] = bucketed(
-        lambda p, k: C.scalar_mul_short(p, k, 64), (2, 1), 2,
-        max_bucket=4096)
+    g["g1_scalar_mul64"] = host_dispatch(
+        ho.g1_scalar_mul64_host, (2, 1),
+        bucketed(lambda p, k: C.scalar_mul_short(p, k, 64), (2, 1), 2,
+                 max_bucket=4096), gate=_ng)
     g["miller"] = host_dispatch(
         ho.miller_host, (1, 1, 2, 2),
         bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32,

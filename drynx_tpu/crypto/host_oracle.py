@@ -229,5 +229,61 @@ def gt_mul_host(a, b) -> np.ndarray:
     return out
 
 
+# ---------------------------------------------------------------------------
+# G1 family (NATIVE-ONLY host path: these are gated on the C++ library —
+# the pure-Python fallback would lose to the XLA bucketed kernels, so the
+# dispatch gate in batching._build only detours when npair.available()).
+# ---------------------------------------------------------------------------
+
+def g1_scalar_mul_host(p, k) -> np.ndarray:
+    from . import native_pairing as npair
+
+    return npair.g1_scalar_mul_batch(p, k, 256)
+
+
+def g1_scalar_mul64_host(p, k) -> np.ndarray:
+    from . import native_pairing as npair
+
+    return npair.g1_scalar_mul_batch(p, k, 64)
+
+
+def g1_add_host(a, b) -> np.ndarray:
+    from . import native_pairing as npair
+
+    return npair.g1_add_batch(a, b)
+
+
+def g1_neg_host(a) -> np.ndarray:
+    from . import native_pairing as npair
+
+    return npair.g1_neg_batch(a)
+
+
+def g1_eq_host(a, b) -> np.ndarray:
+    from . import native_pairing as npair
+
+    return npair.g1_eq_batch(a, b)
+
+
+def g1_normalize_host(p):
+    from . import native_pairing as npair
+
+    return npair.g1_normalize_batch(p)
+
+
+def fixed_base_mul_host(table, k) -> np.ndarray:
+    """k*Base where Base is recovered from the window table's [0][1] entry
+    (table[w][d] = d*16^w*Base — elgamal.FixedBase layout)."""
+    from . import native_pairing as npair
+
+    base = np.asarray(table)[0, 1]                      # (3, 16)
+    k = np.asarray(k)
+    p = np.broadcast_to(base, (k.shape[0],) + base.shape)
+    return npair.g1_scalar_mul_batch(np.ascontiguousarray(p), k, 256)
+
+
 __all__ = ["ENABLED", "pair_host", "miller_host", "final_exp_host",
-           "gt_pow_host", "gt_mul_host", "final_exp_fast"]
+           "gt_pow_host", "gt_mul_host", "final_exp_fast",
+           "g1_scalar_mul_host", "g1_scalar_mul64_host", "g1_add_host",
+           "g1_neg_host", "g1_eq_host", "g1_normalize_host",
+           "fixed_base_mul_host"]

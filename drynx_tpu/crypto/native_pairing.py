@@ -80,6 +80,13 @@ def _load():
                  [_U32P, ctypes.c_int32, _U32P, ctypes.c_uint64]),
                 ("dx_gt_order_check_batch",
                  [_U32P, _U32P, _U8P, ctypes.c_uint64]),
+                ("dx_g1_scalar_mul_batch",
+                 [_U32P, _U32P, ctypes.c_int32, _U32P, ctypes.c_uint64]),
+                ("dx_g1_add_batch", [_U32P] * 3 + [ctypes.c_uint64]),
+                ("dx_g1_neg_batch", [_U32P] * 2 + [ctypes.c_uint64]),
+                ("dx_g1_eq_batch", [_U32P, _U32P, _U8P, ctypes.c_uint64]),
+                ("dx_g1_normalize_batch",
+                 [_U32P, _U32P, _U32P, _U8P, ctypes.c_uint64]),
             ]:
                 fn = getattr(lib, name)
                 fn.restype = None
@@ -183,6 +190,58 @@ def gt_frob_batch(f, e: int) -> np.ndarray:
     return out
 
 
+def g1_scalar_mul_batch(p, k, nbits: int = 256) -> np.ndarray:
+    """k*P batched: p (…, 3, 16) Jacobian Montgomery, k (…, 16) plain
+    limbs (low `nbits` used); output canonical (Z=1 / Z=0-infinity)."""
+    lib = _load()
+    p, k = _prep(p, (3, 16)), _prep(k, (16,))
+    assert p.shape[0] == k.shape[0]
+    out = np.empty_like(p)
+    lib.dx_g1_scalar_mul_batch(_c32(p), _c32(k), ctypes.c_int32(nbits),
+                               _c32(out), p.shape[0])
+    return out
+
+
+def g1_add_batch(a, b) -> np.ndarray:
+    lib = _load()
+    a, b = _prep(a, (3, 16)), _prep(b, (3, 16))
+    assert a.shape[0] == b.shape[0]
+    out = np.empty_like(a)
+    lib.dx_g1_add_batch(_c32(a), _c32(b), _c32(out), a.shape[0])
+    return out
+
+
+def g1_neg_batch(a) -> np.ndarray:
+    lib = _load()
+    a = _prep(a, (3, 16))
+    out = np.empty_like(a)
+    lib.dx_g1_neg_batch(_c32(a), _c32(out), a.shape[0])
+    return out
+
+
+def g1_eq_batch(a, b) -> np.ndarray:
+    lib = _load()
+    a, b = _prep(a, (3, 16)), _prep(b, (3, 16))
+    assert a.shape[0] == b.shape[0]
+    ok = np.empty((a.shape[0],), dtype=np.uint8)
+    lib.dx_g1_eq_batch(_c32(a), _c32(b), ok.ctypes.data_as(_U8P), a.shape[0])
+    return ok.astype(bool)
+
+
+def g1_normalize_batch(p):
+    """(…, 3, 16) -> (x (…, 16), y (…, 16), inf (…,) bool); infinity rows
+    get zero coords (the canonical-bytes encoder masks them anyway)."""
+    lib = _load()
+    p = _prep(p, (3, 16))
+    n = p.shape[0]
+    x = np.empty((n, 16), dtype=np.uint32)
+    y = np.empty((n, 16), dtype=np.uint32)
+    inf = np.empty((n,), dtype=np.uint8)
+    lib.dx_g1_normalize_batch(_c32(p), _c32(x), _c32(y),
+                              inf.ctypes.data_as(_U8P), n)
+    return x, y, inf.astype(bool)
+
+
 def gt_order_check_batch(f) -> np.ndarray:
     """Order-n gate verdicts: ok[i] = frob1(f_i) == f_i^(p-n)  (⇔ f^n = 1
     within GΦ12 — callers must have gated membership first)."""
@@ -199,4 +258,6 @@ def gt_order_check_batch(f) -> np.ndarray:
 
 __all__ = ["ENABLED", "available", "miller_batch", "pair_batch",
            "final_exp_batch", "gt_pow_batch", "gt_cyc_pow_batch",
-           "gt_mul_batch", "gt_frob_batch", "gt_order_check_batch"]
+           "gt_mul_batch", "gt_frob_batch", "gt_order_check_batch",
+           "g1_scalar_mul_batch", "g1_add_batch", "g1_neg_batch",
+           "g1_eq_batch", "g1_normalize_batch"]

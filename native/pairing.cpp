@@ -164,7 +164,94 @@ inline void fp_pow(const Fp& a, const u64 e[4], Fp& r) {
   r = acc;
 }
 
-inline void fp_inv(const Fp& a, Fp& r) { fp_pow(a, K_PM2, r); }
+// --- binary extended GCD inversion (NOT constant-time: the CPU tier is
+// the correctness path, mirroring the equally variable-time Python
+// oracle; the hardened path is the device kernels) -------------------------
+
+inline bool limbs_is_zero(const u64 t[4]) {
+  return !(t[0] | t[1] | t[2] | t[3]);
+}
+
+inline bool limbs_is_one(const u64 t[4]) {
+  return t[0] == 1 && !(t[1] | t[2] | t[3]);
+}
+
+inline int limbs_cmp(const u64 a[4], const u64 b[4]) {
+  for (int i = 3; i >= 0; --i) {
+    if (a[i] != b[i]) return a[i] > b[i] ? 1 : -1;
+  }
+  return 0;
+}
+
+inline void limbs_sub(u64 a[4], const u64 b[4]) {  // a -= b (a >= b)
+  u128 br = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a[i] - b[i] - (u64)br;
+    a[i] = (u64)d;
+    br = (d >> 64) & 1;
+  }
+}
+
+inline void limbs_shr1(u64 a[4], u64 top) {  // a = (top:a) >> 1
+  for (int i = 0; i < 3; ++i) a[i] = (a[i] >> 1) | (a[i + 1] << 63);
+  a[3] = (a[3] >> 1) | (top << 63);
+}
+
+inline void limbs_half_mod_p(u64 x[4]) {  // x = x/2 mod p
+  if (x[0] & 1) {  // (x + p) >> 1, tracking the carry into bit 256
+    u128 c = 0;
+    for (int i = 0; i < 4; ++i) {
+      c += (u128)x[i] + K_P[i];
+      x[i] = (u64)c;
+      c >>= 64;
+    }
+    limbs_shr1(x, (u64)c);
+  } else {
+    limbs_shr1(x, 0);
+  }
+}
+
+// r = a^-1 in the Montgomery domain: ext-gcd gives plain (aR)^-1, then two
+// mults by R^2 restore a^-1 R. ~15x faster than the Fermat ladder.
+inline void fp_inv(const Fp& a, Fp& r) {
+  u64 u[4], v[4], x1[4] = {1, 0, 0, 0}, x2[4] = {0, 0, 0, 0};
+  std::memcpy(u, a.v, sizeof u);
+  std::memcpy(v, K_P, sizeof v);
+  if (limbs_is_zero(u)) {  // mirror pow(0, p-2) = 0
+    fp_zero(r);
+    return;
+  }
+  while (!limbs_is_one(u) && !limbs_is_one(v)) {
+    while (!(u[0] & 1)) {
+      limbs_shr1(u, 0);
+      limbs_half_mod_p(x1);
+    }
+    while (!(v[0] & 1)) {
+      limbs_shr1(v, 0);
+      limbs_half_mod_p(x2);
+    }
+    if (limbs_cmp(u, v) >= 0) {
+      limbs_sub(u, v);
+      Fp d, s1, s2;
+      std::memcpy(s1.v, x1, sizeof x1);
+      std::memcpy(s2.v, x2, sizeof x2);
+      fp_sub(s1, s2, d);
+      std::memcpy(x1, d.v, sizeof x1);
+    } else {
+      limbs_sub(v, u);
+      Fp d, s1, s2;
+      std::memcpy(s1.v, x1, sizeof x1);
+      std::memcpy(s2.v, x2, sizeof x2);
+      fp_sub(s2, s1, d);
+      std::memcpy(x2, d.v, sizeof x2);
+    }
+  }
+  Fp inv_plain, r2;
+  std::memcpy(inv_plain.v, limbs_is_one(u) ? x1 : x2, sizeof inv_plain.v);
+  fp_set(r2, K_R2);
+  fp_mul(inv_plain, r2, inv_plain);  // (aR)^-1 * R
+  fp_mul(inv_plain, r2, r);          // (aR)^-1 * R^2 = a^-1 R
+}
 
 // ---------------------------------------------------------------------------
 // Fp2 = Fp[i]/(i^2 + 1)
@@ -269,29 +356,6 @@ inline void f12_one(Fp12& r) {
   f2_one(r.c[0]);
   for (int k = 1; k < 6; ++k) f2_zero(r.c[k]);
 }
-
-inline void f12_mul(const Fp12& a, const Fp12& b, Fp12& r) {
-  // schoolbook accumulate into 11 slots, then fold with w^6 = XI
-  // (mirror of refimpl.fp12_mul)
-  Fp2 acc[11];
-  for (int k = 0; k < 11; ++k) f2_zero(acc[k]);
-  Fp2 t;
-  for (int j = 0; j < 6; ++j) {
-    for (int k = 0; k < 6; ++k) {
-      f2_mul(a.c[k], b.c[j], t);
-      f2_add(acc[j + k], t, acc[j + k]);
-    }
-  }
-  Fp2 xi;
-  f2_set(xi, K_XI);
-  for (int k = 0; k < 6; ++k) r.c[k] = acc[k];
-  for (int k = 6; k < 11; ++k) {
-    f2_mul(acc[k], xi, t);
-    f2_add(r.c[k - 6], t, r.c[k - 6]);
-  }
-}
-
-inline void f12_sqr(const Fp12& a, Fp12& r) { f12_mul(a, a, r); }
 
 inline void f12_conj6(const Fp12& a, Fp12& r) {
   for (int k = 0; k < 6; ++k) {
@@ -462,6 +526,42 @@ inline void f12_join(const Fp6& A, const Fp6& B, Fp12& f) {
   f.c[3] = B.a1;
   f.c[4] = A.a2;
   f.c[5] = B.a2;
+}
+
+// Fp12 = Fp6[w]/(w^2 - v) view: karatsuba multiplication (3 fp6 muls =
+// 18 fp2 muls vs the 36 of schoolbook) and complex-method squaring
+// (2 fp6 muls = 12). Same field element as refimpl.fp12_mul — all ops
+// fully reduce, so outputs stay bit-identical (asserted by the parity
+// suite). Mirrors pallas_pairing.make_fp12's f12mul/f12sqr.
+inline void f12_mul(const Fp12& a, const Fp12& b, Fp12& r) {
+  Fp6 A1, B1, A2, B2, t0, t1, t2, s1, s2, vb, c0, c1;
+  f12_split(a, A1, B1);
+  f12_split(b, A2, B2);
+  f6_mul(A1, A2, t0);
+  f6_mul(B1, B2, t1);
+  f6_add(A1, B1, s1);
+  f6_add(A2, B2, s2);
+  f6_mul(s1, s2, t2);
+  f6_mul_v(t1, vb);
+  f6_add(t0, vb, c0);
+  f6_sub(t2, t0, c1);
+  f6_sub(c1, t1, c1);
+  f12_join(c0, c1, r);
+}
+
+inline void f12_sqr(const Fp12& a, Fp12& r) {
+  Fp6 A, B, ab, apb, avb, t, c0, c1, vab;
+  f12_split(a, A, B);
+  f6_mul(A, B, ab);
+  f6_add(A, B, apb);
+  f6_mul_v(B, avb);
+  f6_add(A, avb, avb);
+  f6_mul(apb, avb, t);
+  f6_mul_v(ab, vab);
+  f6_sub(t, ab, c0);
+  f6_sub(c0, vab, c0);
+  f6_add(ab, ab, c1);
+  f12_join(c0, c1, r);
 }
 
 inline void f12_inv(const Fp12& f, Fp12& r) {
@@ -708,6 +808,144 @@ inline void miller(const Fp& xp, const Fp& yp, const G2a& q2, Fp12& f) {
 }
 
 // ---------------------------------------------------------------------------
+// G1 (E(Fp): y^2 = x^3 + 3), Jacobian coordinates, Montgomery limbs.
+// Textbook double-and-add (NOT constant-time — the CPU correctness tier;
+// the constant-time path is the device ladder, crypto/curve.py). Outputs
+// are canonicalized to Z=1 (or Z=0 for infinity), which is a valid input
+// representation for every consumer (all are projective-invariant; the
+// repo compares G1 results in affine form — see tests).
+// ---------------------------------------------------------------------------
+
+struct G1j {
+  Fp X, Y, Z;
+};
+
+inline bool g1_is_inf(const G1j& p) { return fp_is_zero(p.Z); }
+
+inline void g1_set_inf(G1j& p) {
+  fp_one(p.X);
+  fp_one(p.Y);
+  fp_zero(p.Z);
+}
+
+inline void g1_dbl(const G1j& p, G1j& r) {
+  if (g1_is_inf(p) || fp_is_zero(p.Y)) {
+    // y = 0 cannot occur on y^2 = x^3 + 3 with prime-order points, but
+    // keep the guard for arbitrary (attacker-supplied) inputs
+    g1_set_inf(r);
+    return;
+  }
+  Fp A, B, C, D, E, F, t, u;
+  fp_sqr(p.X, A);
+  fp_sqr(p.Y, B);
+  fp_sqr(B, C);
+  fp_add(p.X, B, t);
+  fp_sqr(t, t);
+  fp_sub(t, A, t);
+  fp_sub(t, C, t);
+  fp_add(t, t, D);              // D = 2((X+B)^2 - A - C)
+  fp_add(A, A, E);
+  fp_add(E, A, E);              // E = 3A
+  fp_sqr(E, F);
+  Fp X3, Y3, Z3;
+  fp_sub(F, D, X3);
+  fp_sub(X3, D, X3);            // X3 = F - 2D
+  fp_sub(D, X3, t);
+  fp_mul(E, t, Y3);
+  fp_add(C, C, u);
+  fp_add(u, u, u);
+  fp_add(u, u, u);              // 8C
+  fp_sub(Y3, u, Y3);
+  fp_mul(p.Y, p.Z, Z3);
+  fp_add(Z3, Z3, Z3);
+  r.X = X3;
+  r.Y = Y3;
+  r.Z = Z3;
+}
+
+inline void g1_add_jac(const G1j& p, const G1j& q, G1j& r) {
+  if (g1_is_inf(p)) {
+    r = q;
+    return;
+  }
+  if (g1_is_inf(q)) {
+    r = p;
+    return;
+  }
+  Fp Z1Z1, Z2Z2, U1, U2, S1, S2, H, R_, t;
+  fp_sqr(p.Z, Z1Z1);
+  fp_sqr(q.Z, Z2Z2);
+  fp_mul(p.X, Z2Z2, U1);
+  fp_mul(q.X, Z1Z1, U2);
+  fp_mul(q.Z, Z2Z2, t);
+  fp_mul(p.Y, t, S1);
+  fp_mul(p.Z, Z1Z1, t);
+  fp_mul(q.Y, t, S2);
+  fp_sub(U2, U1, H);
+  fp_sub(S2, S1, R_);
+  if (fp_is_zero(H)) {
+    if (fp_is_zero(R_)) {
+      g1_dbl(p, r);
+    } else {
+      g1_set_inf(r);
+    }
+    return;
+  }
+  Fp H2, H3, U1H2, X3, Y3, Z3;
+  fp_sqr(H, H2);
+  fp_mul(H, H2, H3);
+  fp_mul(U1, H2, U1H2);
+  fp_sqr(R_, X3);
+  fp_sub(X3, H3, X3);
+  fp_sub(X3, U1H2, X3);
+  fp_sub(X3, U1H2, X3);          // X3 = R^2 - H^3 - 2*U1*H^2
+  fp_sub(U1H2, X3, t);
+  fp_mul(R_, t, Y3);
+  fp_mul(S1, H3, t);
+  fp_sub(Y3, t, Y3);             // Y3 = R(U1H^2 - X3) - S1*H^3
+  fp_mul(p.Z, q.Z, Z3);
+  fp_mul(Z3, H, Z3);
+  r.X = X3;
+  r.Y = Y3;
+  r.Z = Z3;
+}
+
+// canonicalize to Z = 1 (affine) or the Z = 0 infinity encoding
+inline void g1_affinize(G1j& p) {
+  if (g1_is_inf(p)) {
+    g1_set_inf(p);
+    return;
+  }
+  Fp zi, zi2, zi3;
+  fp_inv(p.Z, zi);
+  fp_sqr(zi, zi2);
+  fp_mul(zi, zi2, zi3);
+  fp_mul(p.X, zi2, p.X);
+  fp_mul(p.Y, zi3, p.Y);
+  fp_one(p.Z);
+}
+
+// k*P over the low `nbits` of k (callers pass 256, or 64 for the short
+// RLC-weight ladders); no mod-N reduction — [k]P is [k]P for any k >= 0
+inline void g1_scalar_mul(const G1j& p, const u64 k[4], int nbits, G1j& r) {
+  G1j acc, add = p;
+  g1_set_inf(acc);
+  for (int w = 0; w < 4 && w * 64 < nbits; ++w) {
+    u64 bits = k[w];
+    int n = nbits - w * 64 < 64 ? nbits - w * 64 : 64;
+    for (int i = 0; i < n; ++i) {
+      if (bits & 1) g1_add_jac(acc, add, acc);
+      g1_dbl(add, add);
+      bits >>= 1;
+    }
+  }
+  r = acc;
+}
+
+inline void pack_g1(const uint32_t* in, G1j& p);    // fwd (needs pack_fp)
+inline void unpack_g1(const G1j& p, uint32_t* out);
+
+// ---------------------------------------------------------------------------
 // uint32[16] (16-bit limbs) <-> u64[4] packing
 // ---------------------------------------------------------------------------
 
@@ -750,6 +988,18 @@ inline void pack_exp(const uint32_t* in, u64 e[4]) {  // plain limbs
   Fp t;
   pack_fp(in, t);
   for (int j = 0; j < 4; ++j) e[j] = t.v[j];
+}
+
+inline void pack_g1(const uint32_t* in, G1j& p) {  // (3, 16)
+  pack_fp(in, p.X);
+  pack_fp(in + 16, p.Y);
+  pack_fp(in + 32, p.Z);
+}
+
+inline void unpack_g1(const G1j& p, uint32_t* out) {
+  unpack_fp(p.X, out);
+  unpack_fp(p.Y, out + 16);
+  unpack_fp(p.Z, out + 32);
 }
 
 }  // namespace
@@ -873,6 +1123,81 @@ void dx_gt_order_check_batch(const uint32_t* f, const uint32_t* t1,
     f12_frob(a, 1, fr);
     f12_cyc_pow(a, e, pw);
     ok[i] = std::memcmp(&fr, &pw, sizeof(Fp12)) == 0 ? 1 : 0;
+  }
+}
+
+// --- G1 family: p/a/b are (n, 3, 16) Jacobian Montgomery points;
+// outputs are canonicalized (Z = 1, or the Z = 0 infinity encoding).
+
+void dx_g1_scalar_mul_batch(const uint32_t* p, const uint32_t* k,
+                            int32_t nbits, uint32_t* out, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G1j a, r;
+    u64 e[4];
+    pack_g1(p + 48 * i, a);
+    pack_exp(k + 16 * i, e);
+    g1_scalar_mul(a, e, (int)nbits, r);
+    g1_affinize(r);
+    unpack_g1(r, out + 48 * i);
+  }
+}
+
+void dx_g1_add_batch(const uint32_t* a, const uint32_t* b, uint32_t* out,
+                     uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G1j x, y, r;
+    pack_g1(a + 48 * i, x);
+    pack_g1(b + 48 * i, y);
+    g1_add_jac(x, y, r);
+    g1_affinize(r);
+    unpack_g1(r, out + 48 * i);
+  }
+}
+
+void dx_g1_neg_batch(const uint32_t* a, uint32_t* out, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G1j x;
+    pack_g1(a + 48 * i, x);
+    fp_neg(x.Y, x.Y);
+    unpack_g1(x, out + 48 * i);
+  }
+}
+
+// outx/outy (n, 16) affine Montgomery coords, inf (n) flags
+void dx_g1_normalize_batch(const uint32_t* p, uint32_t* outx, uint32_t* outy,
+                           uint8_t* inf, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G1j a;
+    pack_g1(p + 48 * i, a);
+    g1_affinize(a);
+    inf[i] = g1_is_inf(a) ? 1 : 0;
+    if (inf[i]) {
+      std::memset(outx + 16 * i, 0, 16 * sizeof(uint32_t));
+      std::memset(outy + 16 * i, 0, 16 * sizeof(uint32_t));
+    } else {
+      unpack_fp(a.X, outx + 16 * i);
+      unpack_fp(a.Y, outy + 16 * i);
+    }
+  }
+}
+
+void dx_g1_eq_batch(const uint32_t* a, const uint32_t* b, uint8_t* ok,
+                    uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G1j x, y;
+    pack_g1(a + 48 * i, x);
+    pack_g1(b + 48 * i, y);
+    g1_affinize(x);
+    g1_affinize(y);
+    bool ix = g1_is_inf(x), iy = g1_is_inf(y);
+    if (ix || iy) {
+      ok[i] = (ix && iy) ? 1 : 0;
+    } else {
+      ok[i] = (std::memcmp(x.X.v, y.X.v, sizeof x.X.v) == 0 &&
+               std::memcmp(x.Y.v, y.Y.v, sizeof x.Y.v) == 0)
+                  ? 1
+                  : 0;
+    }
   }
 }
 

@@ -131,3 +131,72 @@ def test_batch_consistency():
     r = npair.pair_batch(px, py, qx, qy)
     for i, (p, q) in enumerate(pts):
         assert _fp12_to_ref(r[i]) == refimpl.pair(p, q), i
+
+
+def test_g1_family_exact():
+    """Native G1 vs refimpl (affine canonical — so equality is exact) and
+    vs the batching dispatch semantics (infinity encoding, eq, normalize).
+    """
+    import jax.numpy as jnp
+
+    from drynx_tpu.crypto import curve as C
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.crypto import field as F
+
+    pts = [None, refimpl.G1, refimpl.g1_mul(refimpl.G1, 7),
+           refimpl.g1_mul(refimpl.G1, rscalar())]
+    dev = np.stack([C.from_ref(p) for p in pts])
+    ks = [0, 1, 5, params.N - 1, rscalar()]
+
+    # scalar mul (256-bit): every (point, scalar) combination
+    for k in ks:
+        kd = np.broadcast_to(
+            np.asarray(params.to_limbs(k), dtype=np.uint32),
+            (len(pts), 16)).copy()
+        got = npair.g1_scalar_mul_batch(dev, kd, 256)
+        for i, p in enumerate(pts):
+            want = refimpl.g1_mul(p, k) if p is not None else None
+            assert C.to_ref(jnp.asarray(got[i])) == want, (i, k)
+
+    # 64-bit short ladder
+    k64 = (1 << 62) - 3
+    kd = np.broadcast_to(np.asarray(params.to_limbs(k64), dtype=np.uint32),
+                         (len(pts), 16)).copy()
+    got = npair.g1_scalar_mul_batch(dev, kd, 64)
+    for i, p in enumerate(pts):
+        want = refimpl.g1_mul(p, k64) if p is not None else None
+        assert C.to_ref(jnp.asarray(got[i])) == want, i
+
+    # add: all pairs incl. infinity, doubling, and P + (-P)
+    neg = npair.g1_neg_batch(dev)
+    for i, p in enumerate(pts):
+        for j, q in enumerate(pts):
+            r = npair.g1_add_batch(dev[i][None], dev[j][None])
+            assert C.to_ref(jnp.asarray(r[0])) == refimpl.g1_add(p, q), (i, j)
+        r = npair.g1_add_batch(dev[i][None], neg[i][None])
+        assert C.to_ref(jnp.asarray(r[0])) is None, i  # P + (-P) = inf
+
+    # eq: same point under different Z representations
+    two_j = np.asarray(C.add(jnp.asarray(dev[1]), jnp.asarray(dev[1])))
+    two_n = npair.g1_add_batch(dev[1][None], dev[1][None])[0]
+    assert npair.g1_eq_batch(two_j[None], two_n[None])[0]
+    assert not npair.g1_eq_batch(dev[1][None], dev[2][None])[0]
+    assert npair.g1_eq_batch(dev[0][None], dev[0][None])[0]   # inf == inf
+
+    # normalize matches the jnp kernel on finite points
+    xs, ys, infs = npair.g1_normalize_batch(dev)
+    jx, jy, jinf = C.normalize(jnp.asarray(dev))
+    assert infs.tolist() == np.asarray(jinf).tolist()
+    fin = ~infs
+    assert np.array_equal(xs[fin], np.asarray(jx)[fin])
+    assert np.array_equal(ys[fin], np.asarray(jy)[fin])
+
+    # fixed-base host fn: k*B via the recovered table base
+    from drynx_tpu.crypto.host_oracle import fixed_base_mul_host
+
+    kd = np.stack([np.asarray(params.to_limbs(k), dtype=np.uint32)
+                   for k in ks])
+    got = fixed_base_mul_host(eg.BASE_TABLE.table, kd)
+    for i, k in enumerate(ks):
+        want = refimpl.g1_mul(refimpl.G1, k) if k else None
+        assert C.to_ref(jnp.asarray(got[i])) == want, k
