@@ -199,10 +199,14 @@ def _build():
     g["g1_normalize"] = host_dispatch(
         _ho_early.g1_normalize_host, (2,),
         bucketed(C.normalize, (2,), (1, 1, 0), max_bucket=4096), gate=_ng)
-    g["g2_scalar_mul"] = bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32,
-                                  max_bucket=2048)
-    g["g2_normalize"] = bucketed(G2.normalize, (3,), (2, 2, 0),
-                                 min_bucket=32, max_bucket=2048)
+    g["g2_scalar_mul"] = host_dispatch(
+        _ho_early.g2_scalar_mul_host, (3, 1),
+        bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32,
+                 max_bucket=2048), gate=_ng)
+    g["g2_normalize"] = host_dispatch(
+        _ho_early.g2_normalize_host, (3,),
+        bucketed(G2.normalize, (3,), (2, 2, 0),
+                 min_bucket=32, max_bucket=2048), gate=_ng)
     g["fixed_base_mul"] = host_dispatch(
         _ho_early.fixed_base_mul_host, (-1, 1),
         bucketed(eg.fixed_base_mul, (-1, 1), 2, max_bucket=4096), gate=_ng)

@@ -271,6 +271,18 @@ def g1_normalize_host(p):
     return npair.g1_normalize_batch(p)
 
 
+def g2_scalar_mul_host(p, k) -> np.ndarray:
+    from . import native_pairing as npair
+
+    return npair.g2_scalar_mul_batch(p, k, 256)
+
+
+def g2_normalize_host(p):
+    from . import native_pairing as npair
+
+    return npair.g2_normalize_batch(p)
+
+
 def fixed_base_mul_host(table, k) -> np.ndarray:
     """k*Base where Base is recovered from the window table's [0][1] entry
     (table[w][d] = d*16^w*Base — elgamal.FixedBase layout)."""
@@ -286,4 +298,5 @@ __all__ = ["ENABLED", "pair_host", "miller_host", "final_exp_host",
            "gt_pow_host", "gt_mul_host", "final_exp_fast",
            "g1_scalar_mul_host", "g1_scalar_mul64_host", "g1_add_host",
            "g1_neg_host", "g1_eq_host", "g1_normalize_host",
+           "g2_scalar_mul_host", "g2_normalize_host",
            "fixed_base_mul_host"]

@@ -87,6 +87,10 @@ def _load():
                 ("dx_g1_eq_batch", [_U32P, _U32P, _U8P, ctypes.c_uint64]),
                 ("dx_g1_normalize_batch",
                  [_U32P, _U32P, _U32P, _U8P, ctypes.c_uint64]),
+                ("dx_g2_scalar_mul_batch",
+                 [_U32P, _U32P, ctypes.c_int32, _U32P, ctypes.c_uint64]),
+                ("dx_g2_normalize_batch",
+                 [_U32P, _U32P, _U32P, _U8P, ctypes.c_uint64]),
             ]:
                 fn = getattr(lib, name)
                 fn.restype = None
@@ -242,6 +246,30 @@ def g1_normalize_batch(p):
     return x, y, inf.astype(bool)
 
 
+def g2_scalar_mul_batch(p, k, nbits: int = 256) -> np.ndarray:
+    """k*Q batched: p (…, 3, 2, 16) Jacobian Montgomery twist points,
+    k (…, 16) plain limbs; output canonical (Z=1 / Z=0-infinity)."""
+    lib = _load()
+    p, k = _prep(p, (3, 2, 16)), _prep(k, (16,))
+    assert p.shape[0] == k.shape[0]
+    out = np.empty_like(p)
+    lib.dx_g2_scalar_mul_batch(_c32(p), _c32(k), ctypes.c_int32(nbits),
+                               _c32(out), p.shape[0])
+    return out
+
+
+def g2_normalize_batch(p):
+    lib = _load()
+    p = _prep(p, (3, 2, 16))
+    n = p.shape[0]
+    x = np.empty((n, 2, 16), dtype=np.uint32)
+    y = np.empty((n, 2, 16), dtype=np.uint32)
+    inf = np.empty((n,), dtype=np.uint8)
+    lib.dx_g2_normalize_batch(_c32(p), _c32(x), _c32(y),
+                              inf.ctypes.data_as(_U8P), n)
+    return x, y, inf.astype(bool)
+
+
 def gt_order_check_batch(f) -> np.ndarray:
     """Order-n gate verdicts: ok[i] = frob1(f_i) == f_i^(p-n)  (⇔ f^n = 1
     within GΦ12 — callers must have gated membership first)."""
@@ -260,4 +288,5 @@ __all__ = ["ENABLED", "available", "miller_batch", "pair_batch",
            "final_exp_batch", "gt_pow_batch", "gt_cyc_pow_batch",
            "gt_mul_batch", "gt_frob_batch", "gt_order_check_batch",
            "g1_scalar_mul_batch", "g1_add_batch", "g1_neg_batch",
-           "g1_eq_batch", "g1_normalize_batch"]
+           "g1_eq_batch", "g1_normalize_batch",
+           "g2_scalar_mul_batch", "g2_normalize_batch"]

@@ -946,6 +946,128 @@ inline void pack_g1(const uint32_t* in, G1j& p);    // fwd (needs pack_fp)
 inline void unpack_g1(const G1j& p, uint32_t* out);
 
 // ---------------------------------------------------------------------------
+// G2 (twist E'(Fp2): y^2 = x^3 + 3/XI) — same a=0 Jacobian formulas as G1
+// over Fp2 (the curve constant does not appear in add/double).
+// ---------------------------------------------------------------------------
+
+struct G2j {
+  Fp2 X, Y, Z;
+};
+
+inline bool g2j_is_inf(const G2j& p) { return f2_is_zero(p.Z); }
+
+inline void g2j_set_inf(G2j& p) {
+  f2_one(p.X);
+  f2_one(p.Y);
+  f2_zero(p.Z);
+}
+
+inline void g2j_dbl(const G2j& p, G2j& r) {
+  if (g2j_is_inf(p) || f2_is_zero(p.Y)) {
+    g2j_set_inf(r);
+    return;
+  }
+  Fp2 A, B, C, D, E, F, t, u;
+  f2_sqr(p.X, A);
+  f2_sqr(p.Y, B);
+  f2_sqr(B, C);
+  f2_add(p.X, B, t);
+  f2_sqr(t, t);
+  f2_sub(t, A, t);
+  f2_sub(t, C, t);
+  f2_add(t, t, D);
+  f2_tpl(A, E);
+  f2_sqr(E, F);
+  G2j o;
+  f2_sub(F, D, o.X);
+  f2_sub(o.X, D, o.X);
+  f2_sub(D, o.X, t);
+  f2_mul(E, t, o.Y);
+  f2_add(C, C, u);
+  f2_add(u, u, u);
+  f2_add(u, u, u);
+  f2_sub(o.Y, u, o.Y);
+  f2_mul(p.Y, p.Z, o.Z);
+  f2_add(o.Z, o.Z, o.Z);
+  r = o;
+}
+
+inline void g2j_add(const G2j& p, const G2j& q, G2j& r) {
+  if (g2j_is_inf(p)) {
+    r = q;
+    return;
+  }
+  if (g2j_is_inf(q)) {
+    r = p;
+    return;
+  }
+  Fp2 Z1Z1, Z2Z2, U1, U2, S1, S2, H, R_, t;
+  f2_sqr(p.Z, Z1Z1);
+  f2_sqr(q.Z, Z2Z2);
+  f2_mul(p.X, Z2Z2, U1);
+  f2_mul(q.X, Z1Z1, U2);
+  f2_mul(q.Z, Z2Z2, t);
+  f2_mul(p.Y, t, S1);
+  f2_mul(p.Z, Z1Z1, t);
+  f2_mul(q.Y, t, S2);
+  f2_sub(U2, U1, H);
+  f2_sub(S2, S1, R_);
+  if (f2_is_zero(H)) {
+    if (f2_is_zero(R_)) {
+      g2j_dbl(p, r);
+    } else {
+      g2j_set_inf(r);
+    }
+    return;
+  }
+  Fp2 H2, H3, U1H2;
+  G2j o;
+  f2_sqr(H, H2);
+  f2_mul(H, H2, H3);
+  f2_mul(U1, H2, U1H2);
+  f2_sqr(R_, o.X);
+  f2_sub(o.X, H3, o.X);
+  f2_sub(o.X, U1H2, o.X);
+  f2_sub(o.X, U1H2, o.X);
+  f2_sub(U1H2, o.X, t);
+  f2_mul(R_, t, o.Y);
+  f2_mul(S1, H3, t);
+  f2_sub(o.Y, t, o.Y);
+  f2_mul(p.Z, q.Z, o.Z);
+  f2_mul(o.Z, H, o.Z);
+  r = o;
+}
+
+inline void g2j_affinize(G2j& p) {
+  if (g2j_is_inf(p)) {
+    g2j_set_inf(p);
+    return;
+  }
+  Fp2 zi, zi2, zi3;
+  f2_inv(p.Z, zi);
+  f2_sqr(zi, zi2);
+  f2_mul(zi, zi2, zi3);
+  f2_mul(p.X, zi2, p.X);
+  f2_mul(p.Y, zi3, p.Y);
+  f2_one(p.Z);
+}
+
+inline void g2j_scalar_mul(const G2j& p, const u64 k[4], int nbits, G2j& r) {
+  G2j acc, add = p;
+  g2j_set_inf(acc);
+  for (int w = 0; w < 4 && w * 64 < nbits; ++w) {
+    u64 bits = k[w];
+    int n = nbits - w * 64 < 64 ? nbits - w * 64 : 64;
+    for (int i = 0; i < n; ++i) {
+      if (bits & 1) g2j_add(acc, add, acc);
+      g2j_dbl(add, add);
+      bits >>= 1;
+    }
+  }
+  r = acc;
+}
+
+// ---------------------------------------------------------------------------
 // uint32[16] (16-bit limbs) <-> u64[4] packing
 // ---------------------------------------------------------------------------
 
@@ -1177,6 +1299,45 @@ void dx_g1_normalize_batch(const uint32_t* p, uint32_t* outx, uint32_t* outy,
     } else {
       unpack_fp(a.X, outx + 16 * i);
       unpack_fp(a.Y, outy + 16 * i);
+    }
+  }
+}
+
+// --- G2 family: (n, 3, 2, 16) Jacobian Montgomery twist points.
+
+void dx_g2_scalar_mul_batch(const uint32_t* p, const uint32_t* k,
+                            int32_t nbits, uint32_t* out, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G2j a, r;
+    u64 e[4];
+    pack_f2(p + 96 * i, a.X);
+    pack_f2(p + 96 * i + 32, a.Y);
+    pack_f2(p + 96 * i + 64, a.Z);
+    pack_exp(k + 16 * i, e);
+    g2j_scalar_mul(a, e, (int)nbits, r);
+    g2j_affinize(r);
+    unpack_f2(r.X, out + 96 * i);
+    unpack_f2(r.Y, out + 96 * i + 32);
+    unpack_f2(r.Z, out + 96 * i + 64);
+  }
+}
+
+// outx/outy (n, 2, 16) affine coords, inf (n) flags
+void dx_g2_normalize_batch(const uint32_t* p, uint32_t* outx, uint32_t* outy,
+                           uint8_t* inf, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    G2j a;
+    pack_f2(p + 96 * i, a.X);
+    pack_f2(p + 96 * i + 32, a.Y);
+    pack_f2(p + 96 * i + 64, a.Z);
+    g2j_affinize(a);
+    inf[i] = g2j_is_inf(a) ? 1 : 0;
+    if (inf[i]) {
+      std::memset(outx + 32 * i, 0, 32 * sizeof(uint32_t));
+      std::memset(outy + 32 * i, 0, 32 * sizeof(uint32_t));
+    } else {
+      unpack_f2(a.X, outx + 32 * i);
+      unpack_f2(a.Y, outy + 32 * i);
     }
   }
 }

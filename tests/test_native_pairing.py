@@ -200,3 +200,29 @@ def test_g1_family_exact():
     for i, k in enumerate(ks):
         want = refimpl.g1_mul(refimpl.G1, k) if k else None
         assert C.to_ref(jnp.asarray(got[i])) == want, k
+
+
+def test_g2_family_exact():
+    """Native G2 scalar mul/normalize vs refimpl (affine canonical)."""
+    import jax.numpy as jnp
+
+    qs = [None, refimpl.G2, refimpl.g2_mul(refimpl.G2, 11)]
+    dev = np.stack([G2.from_ref(q) for q in qs])
+    for k in (0, 1, 7, params.N - 1, rscalar()):
+        kd = np.broadcast_to(
+            np.asarray(params.to_limbs(k), dtype=np.uint32),
+            (len(qs), 16)).copy()
+        got = npair.g2_scalar_mul_batch(dev, kd, 256)
+        for i, q in enumerate(qs):
+            want = refimpl.g2_mul(q, k) if q is not None else None
+            assert G2.to_ref(jnp.asarray(got[i])) == want, (i, k)
+
+    # normalize matches the jnp path on finite points
+    from drynx_tpu.crypto import g2 as G2mod
+
+    xs, ys, infs = npair.g2_normalize_batch(dev)
+    jx, jy, jinf = G2mod.normalize(jnp.asarray(dev))
+    assert infs.tolist() == np.asarray(jinf).tolist()
+    fin = ~infs
+    assert np.array_equal(xs[fin], np.asarray(jx)[fin])
+    assert np.array_equal(ys[fin], np.asarray(jy)[fin])
