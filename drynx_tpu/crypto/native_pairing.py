@@ -49,25 +49,9 @@ def _load():
         if _LIB is not None or _LIB_FAILED:
             return _LIB
         try:
-            src_m = max(os.path.getmtime(_SRC), os.path.getmtime(_HDR))
-            if (not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < src_m):
-                os.makedirs(_LIB_DIR, exist_ok=True)
-                # build to a per-process temp name, then rename into place:
-                # the suite runs many pytest processes (per-file isolation)
-                # that may all find the .so missing at once — an in-place
-                # -o would let one process dlopen a half-written ELF
-                tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
-                try:
-                    subprocess.run(
-                        ["g++", "-O3", "-march=native", "-funroll-loops",
-                         "-shared", "-fPIC", "-std=c++17",
-                         _SRC, "-o", tmp],
-                        check=True, capture_output=True, text=True)
-                    os.replace(tmp, _LIB_PATH)
-                finally:
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
+            from ..utils.native_build import build_native_lib
+
+            build_native_lib([_SRC, _HDR], _LIB_PATH)
             lib = ctypes.CDLL(_LIB_PATH)
             for name, args in [
                 ("dx_miller_batch", [_U32P] * 5 + [ctypes.c_uint64]),
@@ -122,7 +106,8 @@ def _c32(a: np.ndarray):
 
 def _prep(a, shape_tail) -> np.ndarray:
     a = np.ascontiguousarray(np.asarray(a), dtype=np.uint32)
-    assert a.shape[-len(shape_tail):] == shape_tail, (a.shape, shape_tail)
+    if a.shape[-len(shape_tail):] != shape_tail:
+        raise ValueError(f"bad tail shape {a.shape} (want *{shape_tail})")
     return a.reshape((-1,) + shape_tail)
 
 
@@ -131,8 +116,8 @@ def miller_batch(px, py, qx, qy) -> np.ndarray:
     px, py = _prep(px, (16,)), _prep(py, (16,))
     qx, qy = _prep(qx, (2, 16)), _prep(qy, (2, 16))
     n = px.shape[0]
-    assert py.shape[0] == n and qx.shape[0] == n and qy.shape[0] == n, \
-        (px.shape, py.shape, qx.shape, qy.shape)
+    if not (py.shape[0] == n and qx.shape[0] == n and qy.shape[0] == n):
+        raise ValueError((px.shape, py.shape, qx.shape, qy.shape))
     out = np.empty((n, 6, 2, 16), dtype=np.uint32)
     lib.dx_miller_batch(_c32(px), _c32(py), _c32(qx), _c32(qy), _c32(out), n)
     return out
@@ -143,8 +128,8 @@ def pair_batch(px, py, qx, qy) -> np.ndarray:
     px, py = _prep(px, (16,)), _prep(py, (16,))
     qx, qy = _prep(qx, (2, 16)), _prep(qy, (2, 16))
     n = px.shape[0]
-    assert py.shape[0] == n and qx.shape[0] == n and qy.shape[0] == n, \
-        (px.shape, py.shape, qx.shape, qy.shape)
+    if not (py.shape[0] == n and qx.shape[0] == n and qy.shape[0] == n):
+        raise ValueError((px.shape, py.shape, qx.shape, qy.shape))
     out = np.empty((n, 6, 2, 16), dtype=np.uint32)
     lib.dx_pair_batch(_c32(px), _c32(py), _c32(qx), _c32(qy), _c32(out), n)
     return out
@@ -161,7 +146,8 @@ def final_exp_batch(f) -> np.ndarray:
 def gt_pow_batch(f, k) -> np.ndarray:
     lib = _load()
     f, k = _prep(f, (6, 2, 16)), _prep(k, (16,))
-    assert f.shape[0] == k.shape[0]
+    if f.shape[0] != k.shape[0]:
+        raise ValueError((f.shape, k.shape))
     out = np.empty_like(f)
     lib.dx_gt_pow_batch(_c32(f), _c32(k), _c32(out), f.shape[0])
     return out
@@ -171,7 +157,8 @@ def gt_cyc_pow_batch(f, k) -> np.ndarray:
     """Cyclotomic-squaring pow — f MUST be in GΦ12 (callers gate)."""
     lib = _load()
     f, k = _prep(f, (6, 2, 16)), _prep(k, (16,))
-    assert f.shape[0] == k.shape[0]
+    if f.shape[0] != k.shape[0]:
+        raise ValueError((f.shape, k.shape))
     out = np.empty_like(f)
     lib.dx_gt_cyc_pow_batch(_c32(f), _c32(k), _c32(out), f.shape[0])
     return out
@@ -180,7 +167,8 @@ def gt_cyc_pow_batch(f, k) -> np.ndarray:
 def gt_mul_batch(a, b) -> np.ndarray:
     lib = _load()
     a, b = _prep(a, (6, 2, 16)), _prep(b, (6, 2, 16))
-    assert a.shape[0] == b.shape[0]
+    if a.shape[0] != b.shape[0]:
+        raise ValueError((a.shape, b.shape))
     out = np.empty_like(a)
     lib.dx_gt_mul_batch(_c32(a), _c32(b), _c32(out), a.shape[0])
     return out
@@ -199,7 +187,8 @@ def g1_scalar_mul_batch(p, k, nbits: int = 256) -> np.ndarray:
     limbs (low `nbits` used); output canonical (Z=1 / Z=0-infinity)."""
     lib = _load()
     p, k = _prep(p, (3, 16)), _prep(k, (16,))
-    assert p.shape[0] == k.shape[0]
+    if p.shape[0] != k.shape[0]:
+        raise ValueError((p.shape, k.shape))
     out = np.empty_like(p)
     lib.dx_g1_scalar_mul_batch(_c32(p), _c32(k), ctypes.c_int32(nbits),
                                _c32(out), p.shape[0])
@@ -209,7 +198,8 @@ def g1_scalar_mul_batch(p, k, nbits: int = 256) -> np.ndarray:
 def g1_add_batch(a, b) -> np.ndarray:
     lib = _load()
     a, b = _prep(a, (3, 16)), _prep(b, (3, 16))
-    assert a.shape[0] == b.shape[0]
+    if a.shape[0] != b.shape[0]:
+        raise ValueError((a.shape, b.shape))
     out = np.empty_like(a)
     lib.dx_g1_add_batch(_c32(a), _c32(b), _c32(out), a.shape[0])
     return out
@@ -226,7 +216,8 @@ def g1_neg_batch(a) -> np.ndarray:
 def g1_eq_batch(a, b) -> np.ndarray:
     lib = _load()
     a, b = _prep(a, (3, 16)), _prep(b, (3, 16))
-    assert a.shape[0] == b.shape[0]
+    if a.shape[0] != b.shape[0]:
+        raise ValueError((a.shape, b.shape))
     ok = np.empty((a.shape[0],), dtype=np.uint8)
     lib.dx_g1_eq_batch(_c32(a), _c32(b), ok.ctypes.data_as(_U8P), a.shape[0])
     return ok.astype(bool)
@@ -251,7 +242,8 @@ def g2_scalar_mul_batch(p, k, nbits: int = 256) -> np.ndarray:
     k (…, 16) plain limbs; output canonical (Z=1 / Z=0-infinity)."""
     lib = _load()
     p, k = _prep(p, (3, 2, 16)), _prep(k, (16,))
-    assert p.shape[0] == k.shape[0]
+    if p.shape[0] != k.shape[0]:
+        raise ValueError((p.shape, k.shape))
     out = np.empty_like(p)
     lib.dx_g2_scalar_mul_batch(_c32(p), _c32(k), ctypes.c_int32(nbits),
                                _c32(out), p.shape[0])

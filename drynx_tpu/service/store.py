@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
@@ -31,22 +30,9 @@ def _load_lib():
         if _LIB is not None or _LIB_FAILED:
             return _LIB
         try:
-            if (not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
-                os.makedirs(_LIB_DIR, exist_ok=True)
-                # atomic install: parallel test processes may all build at
-                # once; never let one dlopen a half-written .so
-                tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
-                try:
-                    subprocess.run(
-                        ["g++", "-O3", "-march=native", "-funroll-loops",
-                         "-shared", "-fPIC", "-std=c++17",
-                         _SRC, "-o", tmp],
-                        check=True, capture_output=True)
-                    os.replace(tmp, _LIB_PATH)
-                finally:
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
+            from ..utils.native_build import build_native_lib
+
+            build_native_lib([_SRC], _LIB_PATH)
             lib = ctypes.CDLL(_LIB_PATH)
             lib.pdb_open.restype = ctypes.c_void_p
             lib.pdb_open.argtypes = [ctypes.c_char_p]

@@ -1,0 +1,58 @@
+"""Shared build-on-demand protocol for the native C++ libraries.
+
+One copy of the concurrent-build rules used by every ctypes binding
+(crypto/native_pairing.py, service/store.py):
+  * staleness = sha256 of (compiler flags, every source file's bytes) in a
+    stamp file next to the .so — so a flag change or a tree moved between
+    hosts (-march=native!) rebuilds, which a bare mtime check misses;
+  * compile to a per-pid temp name and os.replace into place — parallel
+    test processes (per-file isolation) may all build at once, and none
+    may ever dlopen a half-written ELF;
+  * CalledProcessError propagates with stderr attached (callers decide
+    whether a missing toolchain is fatal).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+
+FLAGS = ["-O3", "-march=native", "-funroll-loops",
+         "-shared", "-fPIC", "-std=c++17"]
+
+
+def build_native_lib(srcs: list[str], lib_path: str,
+                     flags: list[str] | None = None) -> str:
+    """Ensure lib_path is an up-to-date build of srcs; returns lib_path.
+    srcs[0] is the translation unit; the rest (headers) only feed the
+    staleness hash."""
+    flags = FLAGS if flags is None else flags
+    h = hashlib.sha256(" ".join(flags).encode())
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()
+
+    stamp = lib_path + ".stamp"
+    if os.path.exists(lib_path) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == digest:
+                return lib_path
+
+    os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+    tmp = f"{lib_path}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(["g++", *flags, srcs[0], "-o", tmp],
+                       check=True, capture_output=True, text=True)
+        os.replace(tmp, lib_path)
+        with open(stamp + f".tmp.{os.getpid()}", "w") as f:
+            f.write(digest)
+        os.replace(stamp + f".tmp.{os.getpid()}", stamp)
+    finally:
+        for t in (tmp, stamp + f".tmp.{os.getpid()}"):
+            if os.path.exists(t):
+                os.unlink(t)
+    return lib_path
+
+
+__all__ = ["FLAGS", "build_native_lib"]

@@ -609,13 +609,16 @@ inline void f12_pow(const Fp12& a, const u64 e[4], Fp12& r) {
   r = acc;
 }
 
-// cyclotomic variant (csqr ladder) — input MUST be in GPhi12
-inline void f12_cyc_pow(const Fp12& a, const u64 e[4], Fp12& r) {
+// cyclotomic variant (csqr ladder) — input MUST be in GPhi12; nbits bounds
+// the ladder for exponents known short (the order gate's t-1 is 128-bit)
+inline void f12_cyc_pow(const Fp12& a, const u64 e[4], Fp12& r,
+                        int nbits = 256) {
   Fp12 base = a, acc;
   f12_one(acc);
-  for (int w = 0; w < 4; ++w) {
+  for (int w = 0; w < 4 && w * 64 < nbits; ++w) {
     u64 bits = e[w];
-    for (int i = 0; i < 64; ++i) {
+    int n = nbits - w * 64 < 64 ? nbits - w * 64 : 64;
+    for (int i = 0; i < n; ++i) {
       if (bits & 1) f12_mul(acc, base, acc);
       f12_csqr(base, base);
       bits >>= 1;
@@ -1239,11 +1242,15 @@ void dx_gt_order_check_batch(const uint32_t* f, const uint32_t* t1,
                              uint8_t* ok, uint64_t n) {
   u64 e[4];
   pack_exp(t1, e);
+  // exponent bit bound: t1 = p - n is 128-bit; skip the zero top half
+  int nbits = 256;
+  while (nbits > 1 && !((e[(nbits - 1) / 64] >> ((nbits - 1) % 64)) & 1))
+    --nbits;
   for (uint64_t i = 0; i < n; ++i) {
     Fp12 a, fr, pw;
     pack_f12(f + 192 * i, a);
     f12_frob(a, 1, fr);
-    f12_cyc_pow(a, e, pw);
+    f12_cyc_pow(a, e, pw, nbits);
     ok[i] = std::memcmp(&fr, &pw, sizeof(Fp12)) == 0 ? 1 : 0;
   }
 }
@@ -1344,21 +1351,28 @@ void dx_g2_normalize_batch(const uint32_t* p, uint32_t* outx, uint32_t* outy,
 
 void dx_g1_eq_batch(const uint32_t* a, const uint32_t* b, uint8_t* ok,
                     uint64_t n) {
+  // inversion-free cross-multiplied comparison (mirror of curve.eq)
   for (uint64_t i = 0; i < n; ++i) {
     G1j x, y;
     pack_g1(a + 48 * i, x);
     pack_g1(b + 48 * i, y);
-    g1_affinize(x);
-    g1_affinize(y);
     bool ix = g1_is_inf(x), iy = g1_is_inf(y);
     if (ix || iy) {
       ok[i] = (ix && iy) ? 1 : 0;
-    } else {
-      ok[i] = (std::memcmp(x.X.v, y.X.v, sizeof x.X.v) == 0 &&
-               std::memcmp(x.Y.v, y.Y.v, sizeof x.Y.v) == 0)
-                  ? 1
-                  : 0;
+      continue;
     }
+    Fp Z1Z1, Z2Z2, l, r, t;
+    fp_sqr(x.Z, Z1Z1);
+    fp_sqr(y.Z, Z2Z2);
+    fp_mul(x.X, Z2Z2, l);
+    fp_mul(y.X, Z1Z1, r);
+    bool same_x = std::memcmp(l.v, r.v, sizeof l.v) == 0;
+    fp_mul(y.Z, Z2Z2, t);
+    fp_mul(x.Y, t, l);
+    fp_mul(x.Z, Z1Z1, t);
+    fp_mul(y.Y, t, r);
+    bool same_y = std::memcmp(l.v, r.v, sizeof l.v) == 0;
+    ok[i] = (same_x && same_y) ? 1 : 0;
   }
 }
 
