@@ -220,6 +220,16 @@ def main():
         cluster, sq, clear_sum = _proofs_on_cluster()
 
         def run():
+            # Successive surveys over the same seed re-send byte-identical
+            # payloads, so a timed run after warmup would verify NOTHING —
+            # every verdict would be a VerifyCache hit from the previous
+            # run and the headline would silently exclude verification
+            # compute. Clearing the caches keeps the WITHIN-run cross-VN
+            # dedup (the disclosed vn_verify_dedup factor) while forcing
+            # every proof type to actually verify in the timed window.
+            if cluster.vns is not None:
+                for vn in cluster.vns.vns:
+                    vn.verify_cache.clear()
             t0 = time.perf_counter()
             res = cluster.run_survey(sq)
             dt = time.perf_counter() - t0

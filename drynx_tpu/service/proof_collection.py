@@ -82,6 +82,17 @@ class VerifyCache:
         self.hits = 0
         self.misses = 0
 
+    def clear(self) -> None:
+        """Drop all memoized verdicts (hit/miss counters keep running).
+
+        The bench harness calls this between timed runs: successive
+        LocalCluster surveys over the same seed re-send byte-identical
+        payloads, so without the clear every verify in run N>1 is a cache
+        HIT from the warmup run and the timed number silently excludes
+        verification compute entirely."""
+        with self._lock:
+            self._d.clear()
+
     def get_or_compute(self, key, compute):
         if self.maxsize == 0:      # caching disabled (undeduped control)
             return compute()
