@@ -1,0 +1,25 @@
+"""Root conftest: keep pytest.ini's xdist addopts harmless without xdist.
+
+pytest.ini passes ``-n 2 --dist loadfile --max-worker-restart=6`` so local
+runs parallelise when pytest-xdist is available. On boxes without xdist (or
+under ``-p no:xdist``) those flags would be a usage error before a single
+test collects. Register them as inert options in that case; when the real
+plugin is present its registration wins and ours raises ValueError, which
+we swallow.
+"""
+import pytest
+
+
+def pytest_addoption(parser):
+    group = parser.getgroup("xdist-shim")
+    for args, kwargs in (
+        # _addoption: lowercase short options are reserved for pytest core,
+        # and xdist itself registers -n the same way.
+        (("-n", "--numprocesses"), {"dest": "_shim_numprocesses"}),
+        (("--dist",), {"dest": "_shim_dist"}),
+        (("--max-worker-restart",), {"dest": "_shim_max_worker_restart"}),
+    ):
+        try:
+            group._addoption(*args, action="store", default=None, **kwargs)
+        except ValueError:
+            pass  # pytest-xdist already registered the real option

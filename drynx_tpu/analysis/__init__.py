@@ -1,0 +1,16 @@
+"""drynx_tpu.analysis — AST-based lint pass enforcing the repo's JAX/crypto
+invariants (jit-global-capture, unsafe-pickle, implicit-dtype,
+host-sync-in-hot-path, env-read-into-trace, secret-logging).
+
+Run ``python -m drynx_tpu.analysis`` or see ANALYSIS.md. Deliberately
+jax-free so the linter works even when the accelerator stack is broken.
+"""
+from .core import (REPO_ROOT, RULES, BaselineEntry, Finding, ModuleInfo,
+                   Rule, analyze_paths, analyze_source, apply_baseline,
+                   load_baseline)
+from . import rules as _rules  # noqa: F401  (populate the registry)
+from .cli import DEFAULT_BASELINE, main
+
+__all__ = ["REPO_ROOT", "RULES", "BaselineEntry", "Finding", "ModuleInfo",
+           "Rule", "analyze_paths", "analyze_source", "apply_baseline",
+           "load_baseline", "DEFAULT_BASELINE", "main"]

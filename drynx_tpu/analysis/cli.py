@@ -1,0 +1,96 @@
+"""CLI for the static analyzer.
+
+    python -m drynx_tpu.analysis [paths...]        # lint (default: drynx_tpu/)
+    python -m drynx_tpu.analysis --list-rules
+    python -m drynx_tpu.analysis --format json drynx_tpu/crypto
+
+Exit codes: 0 = clean (all findings baselined/suppressed), 1 = unbaselined
+findings (or stale baseline entries under --strict-baseline), 2 = usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .core import (REPO_ROOT, RULES, analyze_paths, apply_baseline,
+                   load_baseline)
+from . import rules as _rules  # noqa: F401  (register the rule set)
+
+DEFAULT_BASELINE = REPO_ROOT / "LINT_BASELINE.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m drynx_tpu.analysis",
+        description="AST lint pass enforcing drynx-tpu's JAX/crypto "
+                    "invariants (see ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    default=None, help="files/dirs to scan "
+                    "(default: the drynx_tpu package)")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help=f"baseline JSON (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="fail when baseline entries no longer match "
+                         "anything (prune reminder)")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print(f"{rid}: {rule.summary}")
+        return 0
+
+    for rid in args.rules or ():
+        if rid not in RULES:
+            print(f"unknown rule {rid!r}; --list-rules shows the registry",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or [REPO_ROOT / "drynx_tpu"]
+    for p in paths:
+        if not Path(p).exists():
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings = analyze_paths(paths, rules=args.rules)
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    unbaselined, matched, stale = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in unbaselined],
+            "baselined": matched,
+            "stale_baseline_entries": [e.__dict__ for e in stale],
+        }, indent=2))
+    else:
+        for f in unbaselined:
+            print(f.render())
+        for e in stale:
+            print(f"stale baseline entry (prune it): [{e.rule}] {e.file}: "
+                  f"{e.line_text!r}", file=sys.stderr)
+        summary = (f"{len(unbaselined)} finding(s)"
+                   f" ({matched} baselined) in {len(set(f.file for f in findings))or 0} "
+                   f"file(s) with findings")
+        print(summary, file=sys.stderr)
+
+    if unbaselined:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

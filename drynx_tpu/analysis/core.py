@@ -1,0 +1,350 @@
+"""AST lint framework enforcing the repo's JAX/crypto invariants.
+
+Drynx's security argument rests on every node computing over ciphertexts
+correctly; in this port the equivalent hazards are *silent* Python/JAX bug
+classes — jit traces capturing mutable module globals, raw ``pickle.loads``
+on attacker-controlled proof bytes, implicit-dtype arrays corrupting uint32
+limb arithmetic. The rules in :mod:`.rules` mechanically block those classes
+in CI so later perf PRs can refactor the crypto freely.
+
+Framework pieces:
+
+* :class:`Finding` — one violation, ``file:line`` + rule id + message.
+* :class:`Rule` + :func:`register` — the rule registry (:data:`RULES`).
+* :class:`ModuleInfo` — parsed file + the shared derived facts rules need
+  (jit-decorated functions, pallas-call sites, env-derived module globals).
+* inline suppression — ``# drynx: noqa[rule-id]`` (or bare ``noqa`` for all
+  rules) on the offending line.
+* baseline — ``LINT_BASELINE.json`` grandfathers pre-existing findings.
+  Entries are keyed on (rule, file, stripped line text) rather than line
+  numbers so unrelated edits don't invalidate them; each carries a ``why``.
+
+No jax import here: the analyzer must run (and fail loudly) even on a box
+where the accelerator stack is broken.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# Repo root = parent of the drynx_tpu package (this file is
+# drynx_tpu/analysis/core.py). Baseline keys and reported paths are
+# relative to it so results are stable regardless of the caller's cwd.
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+_NOQA_RE = re.compile(r"#\s*drynx:\s*noqa(?:\[([A-Za-z0-9_,\- ]+)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str          # posix path relative to REPO_ROOT when possible
+    line: int          # 1-based
+    message: str
+    line_text: str     # stripped source line (baseline key component)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.file, self.line_text)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``summary`` and implement run()."""
+
+    id: str = ""
+    summary: str = ""
+
+    def run(self, mod: "ModuleInfo") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: "ModuleInfo", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=self.id, file=mod.relpath, line=line,
+                       message=message, line_text=mod.line_text(line))
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the registry."""
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    RULES[rule.id] = rule
+    return cls
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _contains_env_read(node: ast.AST) -> bool:
+    """True when the subtree reads os.environ / os.getenv."""
+    for sub in ast.walk(node):
+        d = _dotted(sub)
+        if d and (d.startswith("os.environ") or d == "os.getenv"):
+            return True
+    return False
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """jax.jit / pjit / partial(jax.jit, ...) / jax.jit(...) shapes."""
+    d = _dotted(dec)
+    if d in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return True
+    if isinstance(dec, ast.Call):
+        fd = _dotted(dec.func)
+        if fd in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return True
+        if fd in ("functools.partial", "partial") and dec.args:
+            return _is_jit_decorator(dec.args[0])
+    return False
+
+
+def _local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside the function (params + assignment targets),
+    used to tell a captured module global from a local shadow."""
+    bound: Set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                            ast.For, ast.comprehension)):
+            tgts = []
+            if isinstance(sub, ast.Assign):
+                tgts = sub.targets
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                tgts = [sub.target]
+            elif isinstance(sub, ast.For):
+                tgts = [sub.target]
+            else:
+                tgts = [sub.target]
+            for t in tgts:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and sub is not fn:
+            bound.add(sub.name)
+        elif isinstance(sub, ast.Global):
+            bound.difference_update(sub.names)
+    return bound
+
+
+class ModuleInfo:
+    """One parsed source file + the derived facts the rules share."""
+
+    def __init__(self, source: str, relpath: str):
+        self.source = source
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self._analyze()
+
+    # -- derived facts ----------------------------------------------------
+
+    def _analyze(self) -> None:
+        self.functions: List[ast.AST] = [
+            n for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+        self.jit_functions = [
+            f for f in self.functions
+            if any(_is_jit_decorator(d) for d in f.decorator_list)]
+
+        # Functions that build a pallas_call: their bodies are evaluated at
+        # trace time and the kernel config (e.g. interpret=FLAG) is baked
+        # into the jit trace of whichever caller jits them.
+        self.pallas_functions = []
+        for f in self.functions:
+            for sub in ast.walk(f):
+                if isinstance(sub, ast.Call):
+                    d = _dotted(sub.func)
+                    if d and d.split(".")[-1] == "pallas_call":
+                        self.pallas_functions.append(f)
+                        break
+        self.traced_functions = list(dict.fromkeys(
+            self.jit_functions + self.pallas_functions))
+
+        # Module-level simple assignments: name -> [assign nodes]
+        self.module_assigns: Dict[str, List[ast.Assign]] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_assigns.setdefault(t.id, []).append(node)
+
+        # Names whose import-time value comes from the process environment.
+        self.env_derived: Dict[str, ast.Assign] = {
+            name: assigns[0]
+            for name, assigns in self.module_assigns.items()
+            if any(_contains_env_read(a.value) for a in assigns)}
+
+        # Names rebound at runtime (multiple module-level assigns, or a
+        # `global` declaration inside any function).
+        self.rebound: Set[str] = {
+            name for name, assigns in self.module_assigns.items()
+            if len(assigns) > 1}
+        for f in self.functions:
+            for sub in ast.walk(f):
+                if isinstance(sub, ast.Global):
+                    self.rebound.update(sub.names)
+
+    # -- helpers ----------------------------------------------------------
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, finding: Finding) -> bool:
+        raw = ""
+        if 1 <= finding.line <= len(self.lines):
+            raw = self.lines[finding.line - 1]
+        m = _NOQA_RE.search(raw)
+        if not m:
+            return False
+        if m.group(1) is None:
+            return True
+        allowed = {r.strip() for r in m.group(1).split(",")}
+        return finding.rule in allowed
+
+
+# ---------------------------------------------------------------------------
+# Scanning
+# ---------------------------------------------------------------------------
+
+def _rel(path: Path) -> str:
+    p = path.resolve()
+    try:
+        return p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def iter_py_files(paths: Sequence[Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def analyze_source(source: str, relpath: str,
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run (a subset of) the rules over one source string. Test entrypoint;
+    also the per-file worker for analyze_paths."""
+    from . import rules as _rules  # noqa: F401  (side effect: registration)
+
+    try:
+        mod = ModuleInfo(source, relpath)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", file=relpath,
+                        line=e.lineno or 1,
+                        message=f"file does not parse: {e.msg}",
+                        line_text="")]
+    selected = (RULES.values() if rules is None
+                else [RULES[r] for r in rules])
+    out: List[Finding] = []
+    for rule in selected:
+        for f in rule.run(mod):
+            if not mod.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.file, f.line, f.rule))
+    return out
+
+
+def analyze_paths(paths: Sequence[Path],
+                  rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="parse-error", file=_rel(path), line=1,
+                message=f"unreadable file: {e}", line_text=""))
+            continue
+        findings.extend(analyze_source(source, _rel(path), rules=rules))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class BaselineEntry:
+    rule: str
+    file: str
+    line_text: str
+    count: int
+    why: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.file, self.line_text)
+
+
+def load_baseline(path: Path) -> List[BaselineEntry]:
+    path = Path(path)
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries = []
+    for e in data.get("entries", []):
+        entries.append(BaselineEntry(
+            rule=e["rule"], file=e["file"], line_text=e["line_text"],
+            count=int(e.get("count", 1)), why=e.get("why", "")))
+    return entries
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Sequence[BaselineEntry],
+                   ) -> Tuple[List[Finding], int, List[BaselineEntry]]:
+    """Returns (unbaselined findings, #matched, stale entries).
+
+    A stale entry matched fewer findings than its count — the debt it
+    grandfathers no longer exists and the entry should be pruned.
+    """
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        budget[e.key()] = budget.get(e.key(), 0) + e.count
+    remaining = dict(budget)
+    unmatched: List[Finding] = []
+    matched = 0
+    for f in findings:
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            matched += 1
+        else:
+            unmatched.append(f)
+    stale: List[BaselineEntry] = []
+    for e in baseline:
+        if remaining.get(e.key(), 0) > 0:
+            stale.append(e)
+            remaining[e.key()] = 0
+    return unmatched, matched, stale

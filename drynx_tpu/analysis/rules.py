@@ -1,0 +1,330 @@
+"""The repo-specific lint rules (see ANALYSIS.md for the full rationale).
+
+Every rule is a static approximation: it must be cheap, zero-dependency
+(no jax import) and err toward flagging — suppressions (`# drynx:
+noqa[rule]`) and the committed baseline absorb deliberate exceptions.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set
+
+from .core import (Finding, ModuleInfo, Rule, _contains_env_read, _dotted,
+                   _local_bindings, register)
+
+# Flags mutated at runtime by tests/kill-switches even when a module only
+# *imports* them (e.g. pallas_pairing re-exports pallas_ops.INTERPRET and
+# tests monkeypatch both copies).
+KNOWN_MUTABLE_FLAGS = {"INTERPRET", "ENABLED", "UNROLL"}
+
+_SECRET_RE = re.compile(
+    r"(^|_)(sk|secret|secrets|priv|privkey|private(_?key)?)(_|$)|secret",
+    re.IGNORECASE)
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log", "lvl", "lvl1", "lvl2", "lvl3"}
+_LOGGER_NAMES = {"log", "logging", "logger", "_logger", "LOG", "LOGGER"}
+
+
+def _in_scope(mod: ModuleInfo, *parts: str) -> bool:
+    return any(f"/{p}/" in f"/{mod.relpath}" for p in parts)
+
+
+def _is_drynx_pkg(mod: ModuleInfo) -> bool:
+    return mod.relpath.startswith("drynx_tpu/") or "/drynx_tpu/" in mod.relpath
+
+
+# ---------------------------------------------------------------------------
+@register
+class JitGlobalCapture(Rule):
+    """A @jax.jit function (or a pallas_call builder — its body runs at
+    trace time) reading a *mutable* module global bakes the value into the
+    trace cache, keyed only on shapes/static args. Flipping the flag later
+    (monkeypatch, kill-switch) silently reuses stale traces — exactly the
+    INTERPRET trace-cache leak in ADVICE.md. Pass such values as static
+    arguments, or accept the capture explicitly via the baseline + a
+    cache-clearing teardown."""
+
+    id = "jit-global-capture"
+    summary = ("jit-traced code reads a mutable module-level flag; the value "
+               "is frozen into the trace cache at first call")
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        mutable = (set(mod.env_derived) | mod.rebound |
+                   (KNOWN_MUTABLE_FLAGS &
+                    _imported_or_assigned_names(mod)))
+        if not mutable:
+            return
+        for fn in mod.traced_functions:
+            local = _local_bindings(fn)
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in mutable and sub.id not in local):
+                    yield self.finding(
+                        mod, sub,
+                        f"trace-time capture of mutable module global "
+                        f"'{sub.id}' in '{fn.name}' — value is frozen into "
+                        f"the jit/pallas trace cache")
+
+
+def _imported_or_assigned_names(mod: ModuleInfo) -> Set[str]:
+    names = set(mod.module_assigns)
+    for node in mod.tree.body:
+        if isinstance(node, ast.ImportFrom):
+            names.update(a.asname or a.name for a in node.names)
+    return names
+
+
+# ---------------------------------------------------------------------------
+@register
+class UnsafePickle(Rule):
+    """VNs deserialize proof bodies sent by the very parties they exist to
+    distrust; `pickle.loads` on those bytes is remote code execution via a
+    crafted __reduce__. All deserialization must go through the restricted
+    unpickler in proofs/safe_pickle.py (the only file allowed here)."""
+
+    id = "unsafe-pickle"
+    summary = ("raw pickle.load(s)/Unpickler outside proofs/safe_pickle.py "
+               "— RCE on attacker-controlled bytes")
+
+    _ALLOWED_SUFFIX = "proofs/safe_pickle.py"
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if mod.relpath.endswith(self._ALLOWED_SUFFIX):
+            return
+        # track `from pickle import loads [as x]`
+        from_pickle: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.ImportFrom) and node.module == "pickle":
+                for a in node.names:
+                    if a.name in ("loads", "load", "Unpickler"):
+                        from_pickle.add(a.asname or a.name)
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            bad = (d in ("pickle.loads", "pickle.load", "pickle.Unpickler")
+                   or (isinstance(sub.func, ast.Name)
+                       and sub.func.id in from_pickle))
+            if bad:
+                yield self.finding(
+                    mod, sub,
+                    f"'{d or sub.func.id}' on untrusted bytes is arbitrary "
+                    f"code execution; use proofs.safe_pickle.safe_loads")
+
+
+# ---------------------------------------------------------------------------
+@register
+class ImplicitDtype(Rule):
+    """The crypto/proof layers are exact uint32 limb arithmetic with
+    jax_enable_x64 on: a dtype-inferred array (weak int64/float64) silently
+    corrupts Montgomery carries or changes a hash transcript. Array
+    constructors inside crypto/ and proofs/ must pin their dtype."""
+
+    id = "implicit-dtype"
+    summary = ("jnp array constructor without an explicit dtype inside "
+               "crypto/ or proofs/ — inferred dtypes corrupt limb math")
+
+    # positional index at which dtype may appear
+    _CTORS = {"jnp.array": 1, "jnp.asarray": 1, "jnp.zeros": 1,
+              "jnp.ones": 1, "jnp.empty": 1, "jnp.full": 2}
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not (_is_drynx_pkg(mod) and _in_scope(mod, "crypto", "proofs")):
+            return
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if d not in self._CTORS:
+                continue
+            if any(k.arg == "dtype" for k in sub.keywords):
+                continue
+            if len(sub.args) > self._CTORS[d]:
+                continue  # dtype passed positionally
+            yield self.finding(
+                mod, sub,
+                f"'{d}' without explicit dtype — pin it (uint32 limb "
+                f"tensors / exact-int statistics must not rely on "
+                f"inference)")
+
+
+# ---------------------------------------------------------------------------
+@register
+class HostSyncInHotPath(Rule):
+    """Inside jit-traced crypto/parallel code, float()/int()/bool()/
+    np.asarray() on a traced value either crashes at trace time or forces a
+    device->host sync that serializes the pipeline; .block_until_ready()
+    inside a trace is always a mistake. Heuristic taint: function
+    parameters (minus static_argnames) and locals derived from them."""
+
+    id = "host-sync-in-hot-path"
+    summary = ("host-synchronizing call on a traced value inside jitted "
+               "crypto/ or parallel/ code")
+
+    _HOST_CASTS = {"float", "int", "bool"}
+    _HOST_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+    _SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not (_is_drynx_pkg(mod) and _in_scope(mod, "crypto", "parallel")):
+            return
+        for fn in mod.traced_functions:
+            tainted = self._tainted_names(fn)
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = _dotted(sub.func)
+                if (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in self._SYNC_METHODS):
+                    if sub.func.attr == "block_until_ready" or \
+                            self._refs_tainted(sub.func.value, tainted):
+                        yield self.finding(
+                            mod, sub,
+                            f"'.{sub.func.attr}()' inside jit-traced "
+                            f"'{fn.name}' forces a host sync")
+                    continue
+                name = d if d in self._HOST_FUNCS else (
+                    sub.func.id if isinstance(sub.func, ast.Name)
+                    and sub.func.id in self._HOST_CASTS else None)
+                if name and any(self._refs_tainted(a, tainted)
+                                for a in sub.args):
+                    yield self.finding(
+                        mod, sub,
+                        f"'{name}()' on a traced value inside jit-traced "
+                        f"'{fn.name}' — crashes at trace time or forces a "
+                        f"device->host sync")
+
+    @staticmethod
+    def _static_args(fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, str):
+                            out.add(n.value)
+        return out
+
+    def _tainted_names(self, fn: ast.AST) -> Set[str]:
+        static = self._static_args(fn)
+        args = fn.args
+        tainted = {a.arg for a in
+                   (args.posonlyargs + args.args + args.kwonlyargs)
+                   if a.arg not in static and a.arg != "self"}
+        # one forward pass of simple propagation through assignments
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) \
+                    and self._refs_tainted(stmt.value, tainted):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        return tainted
+
+    @staticmethod
+    def _refs_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in ast.walk(node))
+
+
+# ---------------------------------------------------------------------------
+@register
+class EnvReadIntoTrace(Rule):
+    """`X = os.environ[...]` at import time, with X read inside jit-traced
+    code, wires process environment into compiled artifacts: two processes
+    with different env silently compute different programs from the same
+    call site, and tests that mutate the env (or monkeypatch X) leave stale
+    traces behind. Thread such config through as explicit (static)
+    arguments instead. Fires at the assignment; the use sites are covered
+    by jit-global-capture."""
+
+    id = "env-read-into-trace"
+    summary = ("import-time os.environ read whose value flows into "
+               "jit-traced code")
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        used_in_trace: Dict[str, List[str]] = {}
+        for fn in mod.traced_functions:
+            local = _local_bindings(fn)
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in mod.env_derived
+                        and sub.id not in local):
+                    used_in_trace.setdefault(sub.id, []).append(fn.name)
+        for name, fns in sorted(used_in_trace.items()):
+            node = mod.env_derived[name]
+            yield self.finding(
+                mod, node,
+                f"import-time environment read bound to '{name}' is "
+                f"captured by jit-traced code ({', '.join(sorted(set(fns)))})"
+            )
+        # direct env reads lexically inside traced functions
+        for fn in mod.traced_functions:
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Attribute, ast.Call)):
+                    d = _dotted(sub if isinstance(sub, ast.Attribute)
+                                else sub.func)
+                    if d and (d.startswith("os.environ") or d == "os.getenv"):
+                        yield self.finding(
+                            mod, sub,
+                            f"os.environ read inside jit-traced "
+                            f"'{fn.name}' is evaluated once at trace time")
+                        break
+
+
+# ---------------------------------------------------------------------------
+@register
+class SecretLogging(Rule):
+    """Secret-key material (ElGamal secrets, Schnorr nonces) must never hit
+    a log stream or stdout: logs cross trust boundaries (CI artifacts,
+    shared hosts) that the ciphertexts are specifically protecting the data
+    from. Flags print()/log.*/logging calls whose arguments reference a
+    secret-shaped identifier."""
+
+    id = "secret-logging"
+    summary = "print/log call referencing secret-key material"
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            if not self._is_log_sink(sub):
+                continue
+            ident = self._secret_ident(sub)
+            if ident:
+                yield self.finding(
+                    mod, sub,
+                    f"'{ident}' looks like secret-key material flowing "
+                    f"into a log/print sink")
+
+    @staticmethod
+    def _is_log_sink(call: ast.Call) -> bool:
+        if isinstance(call.func, ast.Name) and call.func.id == "print":
+            return True
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _LOG_METHODS:
+            root = call.func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            return isinstance(root, ast.Name) and root.id in _LOGGER_NAMES
+        return False
+
+    @classmethod
+    def _secret_ident(cls, call: ast.Call) -> str:
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            for n in ast.walk(arg):
+                name = None
+                if isinstance(n, ast.Name):
+                    name = n.id
+                elif isinstance(n, ast.Attribute):
+                    name = n.attr
+                if name and _SECRET_RE.search(name):
+                    return name
+        return ""

@@ -7,7 +7,10 @@ TOML reader (tomllib) but no writer, so a small emitter for our config shape
 """
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib  # python >= 3.11
+except ModuleNotFoundError:
+    import tomli as tomllib  # same API; tomllib is tomli vendored
 
 
 def loads(text: str) -> dict:

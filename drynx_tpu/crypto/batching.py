@@ -55,7 +55,8 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
                                            is_leaf=lambda x: x is None)
         ranks = jax.tree.flatten(tail_ranks)[0]
         assert len(leaves) == len(ranks), (len(leaves), len(ranks))
-        leaves = [jnp.asarray(l) for l in leaves]
+        # generic pytree leaves: caller's dtypes pass through unchanged
+        leaves = [jnp.asarray(l) for l in leaves]  # drynx: noqa[implicit-dtype]
         batch = jnp.broadcast_shapes(
             *[l.shape[: l.ndim - r] for l, r in zip(leaves, ranks)
               if r >= 0])
@@ -140,9 +141,10 @@ def host_dispatch(host_fn, tail_ranks, kernel_wrapped, gate=None):
                 np.broadcast_to(a, batch + tail)).reshape((-1,) + tail))
         out = host_fn(*flat)
         if isinstance(out, tuple):
-            return tuple(jnp.asarray(o.reshape(batch + o.shape[1:]))
+            return tuple(jnp.asarray(o.reshape(batch + o.shape[1:]))  # drynx: noqa[implicit-dtype]
                          for o in out)
-        return jnp.asarray(out.reshape(batch + out.shape[1:]))
+        # host_fn already returns concrete numpy arrays; keep their dtypes
+        return jnp.asarray(out.reshape(batch + out.shape[1:]))  # drynx: noqa[implicit-dtype]
 
     return wrapped
 
@@ -153,7 +155,7 @@ def tree_reduce_add(tensor, add_fn, axis: int = 0):
     The on-chip analogue of the reference's n-ary CN aggregation tree
     (services/service.go:676); works for points and ciphertexts alike.
     """
-    t = jnp.moveaxis(jnp.asarray(tensor), axis, 0)
+    t = jnp.moveaxis(jnp.asarray(tensor), axis, 0)  # drynx: noqa[implicit-dtype]
     n = int(t.shape[0])
     while n > 1:
         half = n // 2
@@ -367,8 +369,8 @@ def gt_order_ok(a) -> bool:
             if _fp12_frob(f, 1) != refimpl.fp12_cyc_pow(f, t1):
                 return False
         return True
-    flat = jnp.asarray(a).reshape(-1, 6, 2, params.NUM_LIMBS)
-    k = jnp.asarray(np.asarray(params.to_limbs(t1), dtype=np.uint32))
+    flat = jnp.asarray(a, dtype=jnp.uint32).reshape(-1, 6, 2, params.NUM_LIMBS)
+    k = jnp.asarray(np.asarray(params.to_limbs(t1), dtype=np.uint32), dtype=jnp.uint32)
     lhs = gt_frob1(flat)
     rhs = gt_pow128(flat, jnp.broadcast_to(k, (flat.shape[0],) + k.shape))
     return bool(np.all(np.asarray(gt_eq(lhs, rhs))))
@@ -386,7 +388,7 @@ def gt_membership_ok(a) -> bool:
     the batch (a handful of constant Fp2 muls per element)."""
     from . import params
 
-    flat = jnp.asarray(a).reshape(-1, 6, 2, params.NUM_LIMBS)
+    flat = jnp.asarray(a, dtype=jnp.uint32).reshape(-1, 6, 2, params.NUM_LIMBS)
     z2 = gt_frob2(flat)
     z4 = gt_frob2(z2)
     lhs = gt_mul(z4, flat)
@@ -403,7 +405,7 @@ def gt_reduce_prod(x):
     from . import pallas_ops as po
     from . import pallas_pairing as ppair
 
-    x = jnp.asarray(x)
+    x = jnp.asarray(x, dtype=jnp.uint32)
     N = int(x.shape[0])
     if N == 1:
         return x[0]

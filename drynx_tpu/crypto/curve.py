@@ -45,7 +45,7 @@ def from_ref_batch(pts) -> np.ndarray:
 
 def to_ref(pt):
     """(..., 3, 16) device point(s) -> oracle affine point / list of points."""
-    mx, my, inf = normalize(jnp.asarray(pt))
+    mx, my, inf = normalize(jnp.asarray(pt, dtype=jnp.uint32))
     aff_x = np.asarray(F.from_mont(mx, FP))
     aff_y = np.asarray(F.from_mont(my, FP))
     inf = np.asarray(inf)
@@ -64,11 +64,11 @@ def to_ref(pt):
 # ---------------------------------------------------------------------------
 
 def _const(pt):
-    return jnp.asarray(from_ref(pt))
+    return jnp.asarray(from_ref(pt), dtype=jnp.uint32)
 
 
 def infinity(batch_shape=()):
-    base = jnp.asarray(from_ref(None))
+    base = jnp.asarray(from_ref(None), dtype=jnp.uint32)
     return jnp.broadcast_to(base, batch_shape + (3, NUM_LIMBS))
 
 

@@ -79,7 +79,7 @@ class FixedBase:
             # advance base by 16x
             for _ in range(WINDOW_BITS):
                 base = refimpl.g1_add(base, base)
-        self.table = jnp.asarray(np.stack(rows))  # (64, 16, 3, 16)
+        self.table = jnp.asarray(np.stack(rows), dtype=jnp.uint32)  # (64, 16, 3, 16)
 
     def mul(self, k_limbs):
         return fixed_base_mul(self.table, k_limbs)
@@ -226,7 +226,7 @@ def encrypt_ints(key, pub_tbl: FixedBase, values, base_tbl: FixedBase = None):
     Mirrors unlynx EncryptIntGetR (used at lib/encoding/sum.go:24).
     """
     base_tbl = base_tbl or BASE_TABLE
-    values = jnp.asarray(values)
+    values = jnp.asarray(values, dtype=jnp.int64)
     r = random_scalars(key, values.shape)
     ct = encrypt_ints_with_tables(base_tbl.table, pub_tbl.table, values, r)
     return ct, r
@@ -302,11 +302,11 @@ class DecryptionTable:
             keys[i] = ((x & 0x7FFFFFFF) << 1 | (y & 1)) & 0xFFFFFFFF
         order = np.argsort(keys, kind="stable")
         self.limit = limit
-        self.keys = jnp.asarray(keys[order])
-        self.xs = jnp.asarray(xs[order])
+        self.keys = jnp.asarray(keys[order], dtype=jnp.uint32)
+        self.xs = jnp.asarray(xs[order], dtype=jnp.uint32)
         self.ysign = jnp.asarray(
-            np.asarray([pts[i][1] & 1 for i in order], dtype=np.uint32))
-        self.vals = jnp.asarray(np.asarray(vals, dtype=np.int32)[order])
+            np.asarray([pts[i][1] & 1 for i in order], dtype=np.uint32), dtype=jnp.uint32)
+        self.vals = jnp.asarray(np.asarray(vals, dtype=np.int32)[order], dtype=jnp.int32)
 
     def lookup(self, points):
         """Batched point -> int. Returns (values int32, found bool)."""
@@ -340,7 +340,7 @@ def _table_lookup(keys, xs, ysign, vals, points):
 
 def decrypt_ints(ct, secret: int, table: DecryptionTable):
     """Full decryption: (..., 2, 3, 16) cts -> (int32 values, found flags)."""
-    x = jnp.asarray(secret_to_limbs(secret))
+    x = jnp.asarray(secret_to_limbs(secret), dtype=jnp.uint32)
     return table.lookup(decrypt_point(ct, x))
 
 
@@ -363,7 +363,7 @@ def ct_from_ref(kc) -> np.ndarray:
 
 def ct_to_ref(ct):
     flat = np.asarray(ct).reshape(-1, 3, NUM_LIMBS)
-    pts = C.to_ref(jnp.asarray(flat))
+    pts = C.to_ref(jnp.asarray(flat, dtype=jnp.uint32))
     if not isinstance(pts, list):
         pts = [pts]
     out = [(pts[2 * i], pts[2 * i + 1]) for i in range(len(pts) // 2)]

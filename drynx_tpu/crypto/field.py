@@ -300,7 +300,7 @@ def pow_const(a, e: int, ctx: ModCtx = FP, nbits: int = 256):
 
     a in Montgomery form; result in Montgomery form.
     """
-    bits = jnp.asarray(_exp_bits(e, nbits))
+    bits = jnp.asarray(_exp_bits(e, nbits), dtype=jnp.uint32)
     one = jnp.broadcast_to(ctx.one_mont, a.shape)
 
     def step(state, bit):

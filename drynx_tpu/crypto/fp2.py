@@ -25,7 +25,7 @@ def from_ref(a) -> np.ndarray:
 
 
 def to_ref(x):
-    a = np.asarray(F.to_int(np.asarray(F.from_mont(jnp.asarray(x), FP))))
+    a = np.asarray(F.to_int(np.asarray(F.from_mont(jnp.asarray(x, dtype=jnp.uint32), FP))))
     if a.ndim == 1:
         return (int(a[0]), int(a[1]))
     return a  # (..., 2) object array
@@ -107,7 +107,7 @@ def is_zero(a):
 
 
 # Device constant: XI (the sextic non-residue defining Fp12 and the twist)
-XI_DEV = jnp.asarray(from_ref(params.XI))
+XI_DEV = jnp.asarray(from_ref(params.XI), dtype=jnp.uint32)
 
 
 def mul_xi(a):

@@ -30,7 +30,7 @@ def from_ref(pt) -> np.ndarray:
 
 
 def to_ref(pt):
-    x, y, inf = normalize(jnp.asarray(pt))
+    x, y, inf = normalize(jnp.asarray(pt, dtype=jnp.uint32))
     if np.asarray(inf).ndim == 0:
         if bool(inf):
             return None
@@ -39,11 +39,11 @@ def to_ref(pt):
 
 
 def infinity(batch_shape=()):
-    base = jnp.asarray(from_ref(None))
+    base = jnp.asarray(from_ref(None), dtype=jnp.uint32)
     return jnp.broadcast_to(base, batch_shape + (3, 2, NUM_LIMBS))
 
 
-G2_GEN = jnp.asarray(from_ref(refimpl.G2))
+G2_GEN = jnp.asarray(from_ref(refimpl.G2), dtype=jnp.uint32)
 
 
 def is_infinity(p):

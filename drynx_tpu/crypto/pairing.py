@@ -121,7 +121,7 @@ def miller_loop_tate(p_aff, q_aff):
 
     T0 = jnp.stack([xp, yp, jnp.broadcast_to(FP.one_mont, xp.shape)], axis=-2)
     f0 = F12.one(batch)
-    bits = jnp.asarray(_N_BITS)
+    bits = jnp.asarray(_N_BITS, dtype=jnp.uint32)
 
     def step(state, bit):
         T, f = state
@@ -234,8 +234,8 @@ def _twist_frob_consts():
         _G12_DEV = np.asarray(F2.from_ref(refimpl._G12))
         _G13_DEV = np.asarray(F2.from_ref(refimpl._G13))
         _G22_DEV = np.asarray(F2.from_ref(refimpl._G22))
-    return (jnp.asarray(_G12_DEV), jnp.asarray(_G13_DEV),
-            jnp.asarray(_G22_DEV))
+    return (jnp.asarray(_G12_DEV, dtype=jnp.uint32), jnp.asarray(_G13_DEV, dtype=jnp.uint32),
+            jnp.asarray(_G22_DEV, dtype=jnp.uint32))
 
 
 def miller_loop(p_aff, q_aff):
@@ -254,7 +254,7 @@ def miller_loop(p_aff, q_aff):
     one2 = jnp.broadcast_to(F2.one(), xq.shape)
     T0 = jnp.stack([xq, yq, one2], axis=-3)
     f0 = F12.one(batch)
-    bits = jnp.asarray(_ATE_BITS)
+    bits = jnp.asarray(_ATE_BITS, dtype=jnp.uint32)
 
     def step(state, bit):
         T, f = state
@@ -338,7 +338,7 @@ def _frob2_consts():
     for _k in range(6):
         consts.append(F2.from_ref(cur))
         cur = refimpl.fp2_mul(cur, g)
-    return jnp.asarray(np.stack(consts))
+    return jnp.asarray(np.stack(consts), dtype=jnp.uint32)
 
 
 _FROB2 = _frob2_consts()
@@ -361,7 +361,7 @@ def _frob_odd_consts(e: int):
     for _k in range(6):
         consts.append(F2.from_ref(cur))
         cur = refimpl.fp2_mul(cur, g)
-    return jnp.asarray(np.stack(consts))
+    return jnp.asarray(np.stack(consts), dtype=jnp.uint32)
 
 
 _FROB1 = _frob_odd_consts(1)

@@ -40,6 +40,10 @@ LANES = 128                      # batch tile width
 # (any backend) — used by the CPU test suite to cover the kernel code paths.
 INTERPRET = os.environ.get("DRYNX_PALLAS_INTERPRET", "0") == "1"
 
+# jax.enable_x64 exists as a top-level context manager only on some jax
+# versions; on others (e.g. 0.4.37) it lives in jax.experimental.
+enable_x64 = getattr(jax, "enable_x64", None) or jax.experimental.enable_x64
+
 
 # ---------------------------------------------------------------------------
 # Field arithmetic on (16, B) tiles (trace-time unrolled; ~16-step chains)
@@ -286,11 +290,11 @@ def scalar_mul_flat(p, k, n_windows: int = 64):
     pt = _pad_lanes(jnp.transpose(p, (1, 2, 0)), Np)   # (3, 16, Np)
     kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)      # (16, Np)
 
-    m_in = jnp.asarray(_M_FP[:, None])
+    m_in = jnp.asarray(_M_FP[:, None], dtype=jnp.uint32)
     np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
     # x64 mode would make BlockSpec index maps / loop bounds i64, which
     # Mosaic cannot legalize; every value here is uint32, so drop to x32
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np,
                                  n_windows)
     return jnp.transpose(out, (2, 0, 1))[:N]
@@ -378,9 +382,9 @@ def fixed_base_mul_flat(table, k, n_windows: int = 64):
     # (w, v, c, l) -> (w, l, c, v) -> (W, 16, 48)
     tt = jnp.transpose(table[:W], (0, 3, 2, 1)).reshape(W, NL, 48)
 
-    m_in = jnp.asarray(_M_FP[:, None])
+    m_in = jnp.asarray(_M_FP[:, None], dtype=jnp.uint32)
     np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _fixed_base_kernel,
             grid=(n_tiles,),
@@ -447,7 +451,10 @@ def _pad_lanes(x, Np):
     if N == Np:
         return x
     pad = [(0, 0)] * (x.ndim - 1) + [(0, Np - N)]
-    return jnp.pad(x, pad)
+    # pin the fill constant: a weak-typed 0 becomes i64 when traced with
+    # x64 on, and mixing it into the x64-off pallas operand prep produces
+    # a jaxpr that fails MLIR verification at lowering
+    return jnp.pad(x, pad, constant_values=np.zeros((), x.dtype))
 
 
 @jax.jit
@@ -458,7 +465,7 @@ def point_add_flat(p, q):
     Np = n_tiles * LANES
     pt = _pad_lanes(jnp.transpose(p, (1, 2, 0)), Np)
     qt = _pad_lanes(jnp.transpose(q, (1, 2, 0)), Np)
-    m_in = jnp.asarray(_M_FP[:, None])
+    m_in = jnp.asarray(_M_FP[:, None], dtype=jnp.uint32)
     np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
     io = _mk_point_io(n_tiles, Np, extra=[
         pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
@@ -466,7 +473,7 @@ def point_add_flat(p, q):
         pl.BlockSpec((3, NL, LANES), lambda i: (0, 0, i),
                      memory_space=pltpu.VMEM),
     ])
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(_point_add_kernel, interpret=INTERPRET, **io)(m_in, np_in, pt, qt)
     return jnp.transpose(out, (2, 0, 1))[:N]
 
@@ -479,13 +486,13 @@ def point_reduce_flat(pts):
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     pt = _pad_lanes(jnp.transpose(pts, (0, 2, 3, 1)), Np)  # (R,3,16,Np)
-    m_in = jnp.asarray(_M_FP[:, None])
+    m_in = jnp.asarray(_M_FP[:, None], dtype=jnp.uint32)
     np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
     io = _mk_point_io(n_tiles, Np, extra=[
         pl.BlockSpec((R, 3, NL, LANES), lambda i: (0, 0, 0, i),
                      memory_space=pltpu.VMEM),
     ])
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(_point_reduce_kernel, interpret=INTERPRET, **io)(m_in, np_in, pt)
     return jnp.transpose(out, (2, 0, 1))[:N]
 

@@ -32,6 +32,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import params
 from .pallas_ops import (INTERPRET, LANES, MASK, NL, _M_FP, _NPRIME_FP,
+                         enable_x64,
                          _pad_lanes, fadd, fsub, mont_mul)
 
 _XI_A = params.XI[0]          # XI = (3, 1): (x0+x1 i)(3+i)
@@ -434,14 +435,14 @@ def miller_flat(px, py, qx, qy):
     q_in = _pad_lanes(jnp.concatenate(
         [jnp.transpose(t, (1, 2, 0))
          for t in (qx, qy, q1x, q1y, nq2x)], axis=0), Np)     # (10, 16, Np)
-    m_in = jnp.asarray(_M_FP[:, None])
+    m_in = jnp.asarray(_M_FP[:, None], dtype=jnp.uint32)
     np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
-    g_in = jnp.asarray(_twist_frob_tiles())
+    g_in = jnp.asarray(_twist_frob_tiles(), dtype=jnp.uint32)
     bits_in = jnp.asarray(np.broadcast_to(
         np.asarray(_ATE_BITS, dtype=np.uint32)[:, None],
-        (len(_ATE_BITS), LANES)).copy())
+        (len(_ATE_BITS), LANES)).copy(), dtype=jnp.uint32)
 
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _miller_kernel,
             grid=(n_tiles,),
@@ -658,13 +659,13 @@ def f12_slotmul_flat(a, which: str):
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
-    c_in = jnp.asarray(_frob_tiles(which))
+    c_in = jnp.asarray(_frob_tiles(which), dtype=jnp.uint32)
     io = _f12_io(n_tiles, Np, 1)
     io["in_specs"].insert(2, pl.BlockSpec((12, NL, LANES),
                                           lambda i: (0, 0, 0),
                                           memory_space=pltpu.VMEM))
     conj_fp2 = which in ("frob1", "frob3")
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_f12_slotmul_kernel, conj_fp2=conj_fp2),
             interpret=INTERPRET, **io)(m_in, np_in, c_in, _to_tiles(a, Np))
@@ -698,7 +699,7 @@ def _from_tiles(t, N):
 
 
 def _mnp():
-    return (jnp.asarray(_M_FP[:, None]),
+    return (jnp.asarray(_M_FP[:, None], dtype=jnp.uint32),
             jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32))
 
 
@@ -709,7 +710,7 @@ def f12_mul_flat(a, b):
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(_f12_mul_kernel, interpret=INTERPRET,
                              **_f12_io(n_tiles, Np, 2))(
             m_in, np_in, _to_tiles(a, Np), _to_tiles(b, Np))
@@ -722,7 +723,7 @@ def f12_inv_flat(a):
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(_f12_inv_kernel, interpret=INTERPRET,
                              **_f12_io(n_tiles, Np, 1))(
             m_in, np_in, _to_tiles(a, Np))
@@ -738,7 +739,7 @@ def f12_pow_flat(f, k, n_bits: int = 256):
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
     one_in = jnp.asarray(np.asarray(
-        params.to_limbs(params.R % params.P), dtype=np.uint32)[:, None])
+        params.to_limbs(params.R % params.P), dtype=np.uint32)[:, None], dtype=jnp.uint32)
     kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)
     io = _f12_io(n_tiles, Np, 1)
     # insert the one-column spec BEFORE the f12 input, append the exponent
@@ -746,7 +747,7 @@ def f12_pow_flat(f, k, n_bits: int = 256):
                                           memory_space=pltpu.VMEM))
     io["in_specs"].append(pl.BlockSpec((NL, LANES), lambda i: (0, i),
                                        memory_space=pltpu.VMEM))
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_f12_pow_kernel, n_bits=n_bits),
             scratch_shapes=[pltpu.VMEM((n_bits, LANES), jnp.uint32)],
@@ -766,14 +767,14 @@ def f12_wpow_flat(f, k, n_bits: int = 256, wbits: int = 3,
     n_win = (n_bits + wbits - 1) // wbits
     m_in, np_in = _mnp()
     one_in = jnp.asarray(np.asarray(
-        params.to_limbs(params.R % params.P), dtype=np.uint32)[:, None])
+        params.to_limbs(params.R % params.P), dtype=np.uint32)[:, None], dtype=jnp.uint32)
     kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)
     io = _f12_io(n_tiles, Np, 1)
     io["in_specs"].insert(2, pl.BlockSpec((NL, 1), lambda i: (0, 0),
                                           memory_space=pltpu.VMEM))
     io["in_specs"].append(pl.BlockSpec((NL, LANES), lambda i: (0, i),
                                        memory_space=pltpu.VMEM))
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_f12_wpow_kernel, n_bits=n_bits, wbits=wbits,
                               cyc=cyc),
@@ -797,7 +798,7 @@ def f12_csqr_flat(a):
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(_f12_csqr_kernel, interpret=INTERPRET,
                              **_f12_io(n_tiles, Np, 1))(
             m_in, np_in, _to_tiles(a, Np))
@@ -816,7 +817,7 @@ def f12_mulreduce8_flat(g):
     io["in_specs"].append(pl.BlockSpec((8, 12, NL, LANES),
                                        lambda i: (0, 0, 0, i),
                                        memory_space=pltpu.VMEM))
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(_f12_mulreduce8_kernel, interpret=INTERPRET,
                              **io)(m_in, np_in, gt)
     return _from_tiles(out, N)
@@ -886,7 +887,7 @@ def fp_inv_flat(x):
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
     xt = _pad_lanes(jnp.transpose(x, (1, 0)), Np)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _fp_inv_kernel,
             grid=(n_tiles,),
@@ -925,7 +926,7 @@ def f2_inv_flat(a):
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
     at = _pad_lanes(jnp.transpose(a, (1, 2, 0)), Np)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _f2_inv_kernel,
             grid=(n_tiles,),
@@ -1075,7 +1076,7 @@ def g2_scalar_mul_flat(p, k):
     pt = _pad_lanes(jnp.transpose(p.reshape(N, 6, NL), (1, 2, 0)), Np)
     kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)
     m_in, np_in = _mnp()
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _g2_scalar_mul_kernel,
             grid=(n_tiles,),
@@ -1109,7 +1110,7 @@ def _u_limbs(N):
     global _U_LIMBS
     if _U_LIMBS is None:
         _U_LIMBS = np.asarray(params.to_limbs(params.U), dtype=np.uint32)
-    return jnp.broadcast_to(jnp.asarray(_U_LIMBS), (N, NL))
+    return jnp.broadcast_to(jnp.asarray(_U_LIMBS, dtype=jnp.uint32), (N, NL))
 
 
 def final_exp_flat(f):

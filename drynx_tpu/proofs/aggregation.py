@@ -36,8 +36,8 @@ class AggregationProofBatch:
 
 
 def create_aggregation_proof(inputs, aggregate) -> AggregationProofBatch:
-    return AggregationProofBatch(inputs=jnp.asarray(inputs),
-                                 aggregate=jnp.asarray(aggregate))
+    return AggregationProofBatch(inputs=jnp.asarray(inputs, dtype=jnp.uint32),
+                                 aggregate=jnp.asarray(aggregate, dtype=jnp.uint32))
 
 
 def verify_aggregation_proof(proof: AggregationProofBatch) -> np.ndarray:
@@ -45,7 +45,7 @@ def verify_aggregation_proof(proof: AggregationProofBatch) -> np.ndarray:
     from ..crypto import batching as B
 
     acc = B.tree_reduce_add(proof.inputs, B.ct_add)
-    ok = C.eq(acc, jnp.asarray(proof.aggregate))  # (V, 2)
+    ok = C.eq(acc, jnp.asarray(proof.aggregate, dtype=jnp.uint32))  # (V, 2)
     return np.asarray(jnp.all(ok, axis=-1))
 
 

@@ -59,7 +59,7 @@ def g1_bytes(pts) -> np.ndarray:
     (challenges + serialization hit many shapes per survey)."""
     from ..crypto import batching as B
 
-    x_m, y_m, inf = B.g1_normalize(jnp.asarray(pts))
+    x_m, y_m, inf = B.g1_normalize(jnp.asarray(pts, dtype=jnp.uint32))
     x = np.asarray(B.from_mont_p(x_m))
     y = np.asarray(B.from_mont_p(y_m))
     out = np.concatenate([limbs_to_bytes(x), limbs_to_bytes(y)], axis=-1)
@@ -71,7 +71,7 @@ def g2_bytes(pts) -> np.ndarray:
     """Jacobian Montgomery G2 (..., 3, 2, 16) -> canonical (..., 128) uint8."""
     from ..crypto import batching as B
 
-    x_m, y_m, inf = B.g2_normalize(jnp.asarray(pts))
+    x_m, y_m, inf = B.g2_normalize(jnp.asarray(pts, dtype=jnp.uint32))
     plain = np.asarray(B.from_mont_p(
         jnp.stack([x_m, y_m], axis=-3)))         # (..., 2, 2, 16)
     parts = [plain[..., 0, 0, :], plain[..., 0, 1, :],
@@ -85,7 +85,7 @@ def gt_bytes(f) -> np.ndarray:
     """GT element (..., 6, 2, 16) Montgomery -> (..., 384) uint8."""
     from ..crypto import batching as B
 
-    a = np.asarray(B.from_mont_p(jnp.asarray(f)))  # (..., 6, 2, 16)
+    a = np.asarray(B.from_mont_p(jnp.asarray(f, dtype=jnp.uint32)))  # (..., 6, 2, 16)
     b = limbs_to_bytes(a)  # (..., 6, 2, 32)
     return b.reshape(b.shape[:-3] + (6 * 2 * 2 * NUM_LIMBS,))
 

@@ -77,7 +77,7 @@ def _challenge_from_wire(w: dict, ns: int, V: int) -> jnp.ndarray:
     qb = np.broadcast_to(w["q"], (ns, V, 64))
     return jnp.asarray(enc.hash_to_scalar(
         kb, w["u"], w["w"], yb, qb, w["a1"], w["a2"], w["a3"],
-        batch_shape=(ns, V)))
+        batch_shape=(ns, V)), dtype=jnp.uint32)
 
 
 def _commit_kernel(orig_k, q_tbl, wr, wx):
@@ -117,18 +117,18 @@ def create_keyswitch_proofs(key, orig_k, srv_x, ks_rs, q_pt, q_tbl,
     wx = eg.random_scalars(k2, (ns, V))
     a1, a2, a3 = _commit_kernel(orig_k, q_tbl, wr, wx)
     base = eg.BASE_TABLE.table
-    ys = eg.fixed_base_mul(base, jnp.asarray(srv_x))
+    ys = eg.fixed_base_mul(base, jnp.asarray(srv_x, dtype=jnp.uint32))
     # build the batch FIRST, then hash via the shared _wire_dict (computed
     # transiently — see the no-cache NOTE on the dataclass)
-    pb = KeySwitchProofBatch(orig_k=jnp.asarray(orig_k), u_pts=u_pts,
-                             w_pts=w_pts, ys=ys, q_pt=jnp.asarray(q_pt),
+    pb = KeySwitchProofBatch(orig_k=jnp.asarray(orig_k, dtype=jnp.uint32), u_pts=u_pts,
+                             w_pts=w_pts, ys=ys, q_pt=jnp.asarray(q_pt, dtype=jnp.uint32),
                              a1=a1, a2=a2, a3=a3,
                              challenge=jnp.zeros((ns, V, 16), jnp.uint32),
                              zr=jnp.zeros((ns, V, 16), jnp.uint32),
                              zx=jnp.zeros((ns, V, 16), jnp.uint32))
     c = _challenge_from_wire(_wire_dict(pb), ns, V)
-    zr, zx = _response_kernel(wr, wx, c, jnp.asarray(ks_rs),
-                              jnp.asarray(srv_x)[:, None, :])
+    zr, zx = _response_kernel(wr, wx, c, jnp.asarray(ks_rs, dtype=jnp.uint32),
+                              jnp.asarray(srv_x, dtype=jnp.uint32)[:, None, :])
     pb.challenge, pb.zr, pb.zx = c, zr, zx
     return pb
 
@@ -145,7 +145,7 @@ def _verify_kernel(orig_k, u_pts, w_pts, ys, q_tbl, a1, a2, a3, c, zr, zx):
     ok2 = B.g1_eq(lhs2, B.g1_add(a2, B.g1_scalar_mul(w_pts, c)))
     ok3 = B.g1_eq(B.fixed_base_mul(base, zx),
                   B.g1_add(a3, B.g1_scalar_mul(ys[:, None], c)))
-    return jnp.asarray(ok1) & jnp.asarray(ok2) & jnp.asarray(ok3)
+    return jnp.asarray(ok1, dtype=jnp.bool_) & jnp.asarray(ok2, dtype=jnp.bool_) & jnp.asarray(ok3, dtype=jnp.bool_)
 
 
 def verify_keyswitch_proofs(proof: KeySwitchProofBatch, q_tbl) -> np.ndarray:

@@ -65,7 +65,7 @@ def _response_kernel(w, c, s):
 def _challenge(orig, obf, a1, a2) -> jnp.ndarray:
     return jnp.asarray(enc.hash_to_scalar(
         enc.ct_bytes(orig), enc.ct_bytes(obf), enc.g1_bytes(a1),
-        enc.g1_bytes(a2), batch_shape=orig.shape[:-3]))
+        enc.g1_bytes(a2), batch_shape=orig.shape[:-3]), dtype=jnp.uint32)
 
 
 def create_obfuscation_proofs(key, ct, s) -> ObfuscationProofBatch:
@@ -76,7 +76,7 @@ def create_obfuscation_proofs(key, ct, s) -> ObfuscationProofBatch:
     a1, a2 = _commit_kernel(ct, w)
     c = _challenge(ct, obf, a1, a2)
     z = _response_kernel(w, c, s)
-    return ObfuscationProofBatch(orig=jnp.asarray(ct), obf=obf, a1=a1, a2=a2,
+    return ObfuscationProofBatch(orig=jnp.asarray(ct, dtype=jnp.uint32), obf=obf, a1=a1, a2=a2,
                                  challenge=c, z=z)
 
 
@@ -89,7 +89,7 @@ def _verify_kernel(orig, obf, a1, a2, c, z):
                   B.g1_add(a1, B.g1_scalar_mul(Kp, c)))
     ok2 = B.g1_eq(B.g1_scalar_mul(Cc, z),
                   B.g1_add(a2, B.g1_scalar_mul(Cp, c)))
-    return jnp.asarray(ok1) & jnp.asarray(ok2)
+    return jnp.asarray(ok1, dtype=jnp.bool_) & jnp.asarray(ok2, dtype=jnp.bool_)
 
 
 def verify_obfuscation_proofs(proof: ObfuscationProofBatch) -> np.ndarray:

@@ -97,19 +97,19 @@ def sig_gt_table(sigs: list["RangeSig"]) -> jnp.ndarray:
 
     missing = [sg for sg in sigs if sg.gt is None]
     if missing:
-        A_all = jnp.asarray(np.stack([sg.A for sg in missing]))
+        A_all = jnp.asarray(np.stack([sg.A for sg in missing]), dtype=jnp.uint32)
         qx, qy, _ = B.g2_normalize(A_all)
         bx = jnp.asarray(F.to_mont(jnp.asarray(
-            F.from_int(params.G1_GEN[0])), FP))
+            F.from_int(params.G1_GEN[0]), dtype=jnp.uint32), FP), dtype=jnp.uint32)
         by = jnp.asarray(F.to_mont(jnp.asarray(
-            F.from_int(params.G1_GEN[1])), FP))
+            F.from_int(params.G1_GEN[1]), dtype=jnp.uint32), FP), dtype=jnp.uint32)
         gt = np.asarray(B.pair(bx, by, qx, qy))
         for i, sg in enumerate(missing):
             sg.gt = gt[i]
             _GT_TABLE_CACHE[_key(sg)] = gt[i]
         while len(_GT_TABLE_CACHE) > _GT_TABLE_CACHE_MAX:
             _GT_TABLE_CACHE.pop(next(iter(_GT_TABLE_CACHE)))
-    return jnp.asarray(np.stack([sg.gt for sg in sigs]))
+    return jnp.asarray(np.stack([sg.gt for sg in sigs]), dtype=jnp.uint32)
 
 
 _GT_TABLE_CACHE: dict = {}
@@ -171,7 +171,7 @@ def _sig_gt_pow_tables_dev(sigs: list["RangeSig"]) -> jnp.ndarray:
     key = hashlib.sha256(b"".join(sg.A.tobytes() for sg in sigs)).digest()
     dev = _GT_POW_TABLE_DEV.get(key)
     if dev is None:
-        dev = jnp.asarray(sig_gt_pow_tables(sigs))
+        dev = jnp.asarray(sig_gt_pow_tables(sigs), dtype=jnp.uint32)
         _GT_POW_TABLE_DEV[key] = dev
         while len(_GT_POW_TABLE_DEV) > _GT_POW_TABLE_MAX:
             _GT_POW_TABLE_DEV.pop(next(iter(_GT_POW_TABLE_DEV)))
@@ -311,9 +311,9 @@ class RangeProofBatch:
         a = _gt_from_bytes(a_b)
         wire = {"commit": commit_b.reshape(V, 128).copy(), "d": d_b.copy(),
                 "v": v_b.copy(), "a": a_b.copy()}
-        return cls(jnp.asarray(commit), jnp.asarray(challenge),
-                   jnp.asarray(zr), jnp.asarray(d), jnp.asarray(zphi),
-                   jnp.asarray(zv), jnp.asarray(v_pts), jnp.asarray(a), u, l,
+        return cls(jnp.asarray(commit, dtype=jnp.uint32), jnp.asarray(challenge, dtype=jnp.uint32),
+                   jnp.asarray(zr, dtype=jnp.uint32), jnp.asarray(d, dtype=jnp.uint32), jnp.asarray(zphi, dtype=jnp.uint32),
+                   jnp.asarray(zv, dtype=jnp.uint32), jnp.asarray(v_pts, dtype=jnp.uint32), jnp.asarray(a, dtype=jnp.uint32), u, l,
                    wire=wire)
 
 
@@ -324,8 +324,8 @@ def _g1_from_bytes(b: np.ndarray) -> np.ndarray:
     x = enc.bytes_to_limbs(b[..., :32])
     y = enc.bytes_to_limbs(b[..., 32:])
     inf = np.all(b == 0, axis=-1)
-    xm = np.asarray(B.to_mont_p(jnp.asarray(x)))
-    ym = np.asarray(B.to_mont_p(jnp.asarray(y)))
+    xm = np.asarray(B.to_mont_p(jnp.asarray(x, dtype=jnp.uint32)))
+    ym = np.asarray(B.to_mont_p(jnp.asarray(y, dtype=jnp.uint32)))
     one = np.broadcast_to(np.asarray(FP.one_mont), xm.shape).copy()
     one[inf] = 0
     ym = ym.copy()
@@ -341,9 +341,9 @@ def _g2_from_bytes(b: np.ndarray) -> np.ndarray:
 
     comps = [enc.bytes_to_limbs(b[..., 32 * k:32 * (k + 1)]) for k in range(4)]
     inf = np.all(b == 0, axis=-1)
-    xm = np.stack([np.asarray(B.to_mont_p(jnp.asarray(c)))
+    xm = np.stack([np.asarray(B.to_mont_p(jnp.asarray(c, dtype=jnp.uint32)))
                    for c in comps[:2]], axis=-2)
-    ym = np.stack([np.asarray(B.to_mont_p(jnp.asarray(c)))
+    ym = np.stack([np.asarray(B.to_mont_p(jnp.asarray(c, dtype=jnp.uint32)))
                    for c in comps[2:]], axis=-2)
     zm = np.zeros_like(xm)
     zm[..., 0, :] = np.asarray(FP.one_mont)
@@ -362,7 +362,7 @@ def _gt_from_bytes(b: np.ndarray) -> np.ndarray:
     from ..crypto import batching as B
 
     limbs = enc.bytes_to_limbs(b.reshape(b.shape[:-1] + (12, 32)))
-    return np.asarray(B.to_mont_p(jnp.asarray(limbs))).reshape(
+    return np.asarray(B.to_mont_p(jnp.asarray(limbs, dtype=jnp.uint32))).reshape(
         b.shape[:-1] + (6, 2, params.NUM_LIMBS))
 
 
@@ -382,7 +382,7 @@ def gt_base():
     if _GT_B is None:
         _GT_B = np.asarray(F12.from_ref(refimpl.pair(refimpl.G1,
                                                      refimpl.G2)))
-    return jnp.asarray(_GT_B)
+    return jnp.asarray(_GT_B, dtype=jnp.uint32)
 
 
 _GT_B_TABLE = None
@@ -408,7 +408,7 @@ def gt_base_table() -> jnp.ndarray:
             for _ in range(4):
                 cur = refimpl.fp12_mul(cur, cur)
         _GT_B_TABLE = T  # host numpy; converted per use (tracer safety)
-    return jnp.asarray(_GT_B_TABLE)
+    return jnp.asarray(_GT_B_TABLE, dtype=jnp.uint32)
 
 
 def gt_pow_gtb(k):
@@ -432,7 +432,7 @@ def _upow_mont(u: int, l: int) -> jnp.ndarray:
     """[u^j mod n for j<l] in Montgomery form, (l, 16)."""
     rows = [F.from_int((pow(u, j, params.N) * params.R) % params.N)
             for j in range(l)]
-    return jnp.asarray(np.stack(rows))
+    return jnp.asarray(np.stack(rows), dtype=jnp.uint32)
 
 
 def _weighted_sum_mod_n(s_plain, upow_m):
@@ -462,10 +462,10 @@ def _range_wire_dict(commit, d, v_pts, a) -> dict:
     """THE one definition of the canonical commitment encoding — creation,
     wire_bytes and the device-tensor challenge path all call this so the
     Fiat-Shamir transcript can never desynchronize between them."""
-    return {"commit": enc.ct_bytes(jnp.asarray(commit)),
-            "d": enc.g1_bytes(jnp.asarray(d)),
-            "v": enc.g2_bytes(jnp.asarray(v_pts)),
-            "a": enc.gt_bytes(jnp.asarray(a))}
+    return {"commit": enc.ct_bytes(jnp.asarray(commit, dtype=jnp.uint32)),
+            "d": enc.g1_bytes(jnp.asarray(d, dtype=jnp.uint32)),
+            "v": enc.g2_bytes(jnp.asarray(v_pts, dtype=jnp.uint32)),
+            "a": enc.gt_bytes(jnp.asarray(a, dtype=jnp.uint32))}
 
 
 def _g1_bytes_host(pt) -> np.ndarray:
@@ -618,14 +618,14 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     """
     V = int(np.asarray(secrets).shape[0])
     ns = len(sigs)
-    digits = jnp.asarray(to_base(np.asarray(secrets), u, l))  # (V, l)
+    digits = jnp.asarray(to_base(np.asarray(secrets), u, l), dtype=jnp.int32)  # (V, l)
 
     ks = jax.random.split(key, 4)
     s = eg.random_scalars(ks[0], (V, l))
     t = eg.random_scalars(ks[1], (V, l))
     m = eg.random_scalars(ks[2], (V, l))
     v = eg.random_scalars(ks[3], (ns, V, l))
-    A_tab = jnp.asarray(np.stack([sg.A for sg in sigs]))   # (ns, u, 3, 2, 16)
+    A_tab = jnp.asarray(np.stack([sg.A for sg in sigs]), dtype=jnp.uint32)   # (ns, u, 3, 2, 16)
     gtA = sig_gt_table(sigs) if use_gt_table else None
     # per-base window tables make the digit pow squaring-free on the Mosaic
     # path; the CPU/oracle path keeps the direct pow (no table build cost)
@@ -641,10 +641,10 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
         digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA,
         gtA_pow=gtA_pow)
     wire = _range_wire_dict(cts, D, V_pts, a)
-    c = jnp.asarray(challenge_from_wire(wire, sum_publics_bytes(sigs), u, l))
-    zphi, zr, zv = _response_kernel(digits, c, jnp.asarray(rs), s, t,
+    c = jnp.asarray(challenge_from_wire(wire, sum_publics_bytes(sigs), u, l), dtype=jnp.uint32)
+    zphi, zr, zv = _response_kernel(digits, c, jnp.asarray(rs, dtype=jnp.uint32), s, t,
                                     m_tot, v)
-    return RangeProofBatch(commit=jnp.asarray(cts), challenge=c, zr=zr, d=D,
+    return RangeProofBatch(commit=jnp.asarray(cts, dtype=jnp.uint32), challenge=c, zr=zr, d=D,
                            zphi=zphi, zv=zv, v_pts=V_pts, a=a, u=u, l=l,
                            wire=wire)
 
@@ -698,7 +698,7 @@ def verify_range_proofs(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     Fiat-Shamir challenge over D ‖ V_pts ‖ a MUST match; this is the
     soundness-critical binding, see module docstring.)
     """
-    ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]))
+    ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]), dtype=jnp.uint32)
     ok = np.asarray(_verify_kernel(
         proof.commit, proof.challenge, proof.zr, proof.d, proof.zphi,
         proof.zv, proof.v_pts, proof.a, ys, ca_pub_table,
@@ -761,7 +761,7 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     from ..crypto import pallas_ops as po
 
     sync = jax.block_until_ready if po.available() else (lambda x: x)
-    ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]))
+    ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]), dtype=jnp.uint32)
     c, zphi = proof.challenge, proof.zphi
     base_tbl = eg.BASE_TABLE.table
 
@@ -770,7 +770,7 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
         check_challenge=check_challenge)
     if not pre_ok:
         return False  # D equation / challenge binding failed — deterministic
-    r = B.int_to_scalar(jnp.asarray(r_int))               # (ns, V, l, 16)
+    r = B.int_to_scalar(jnp.asarray(r_int, dtype=jnp.int64))               # (ns, V, l, 16)
 
     # r·(c·y_i − Zphi_j·B), then Miller only (final exp shared).
     # g1_scalar_mul64: the RLC weights are 62-bit, so the weighting ladder
@@ -784,7 +784,7 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     sync(qx)
     m = B.miller(px, py, qx, qy)                          # (ns, V, l, 6,2,16)
     sync(m)
-    ar = B.gt_pow64(F12.conj6(jnp.asarray(proof.a)), r)
+    ar = B.gt_pow64(F12.conj6(jnp.asarray(proof.a, dtype=jnp.uint32)), r)
     sync(ar)
 
     # final-exp ONLY the Miller product (the a^r factors are already in GT —
@@ -796,7 +796,7 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 
     # gtB^(Σ r·Zv) comes from the shared prelude (one fixed-base power)
     total = B.gt_mul(B.gt_mul(fe, Pa[None]), gtb_pow_s[None])[0]
-    return bool(np.asarray(F12.eq(total, jnp.asarray(F12.one()))))
+    return bool(np.asarray(F12.eq(total, jnp.asarray(F12.one(), dtype=jnp.uint32))))
 
 
 def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
@@ -828,7 +828,7 @@ def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     ns, V = len(sigs_pub), proof.n_values
     upow_m = _upow_mont(u, l)
 
-    C2 = jnp.asarray(proof.commit)[..., 1, :, :]
+    C2 = jnp.asarray(proof.commit, dtype=jnp.uint32)[..., 1, :, :]
     wz = _weighted_sum_mod_n(proof.zphi, upow_m)
     Dp = B.g1_add(B.g1_scalar_mul(C2, proof.challenge),
                   B.g1_add(B.fixed_base_mul(ca_pub_table, proof.zr),
@@ -845,8 +845,8 @@ def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
 
     gtb_pow_s = None
     if with_gtb_pow:
-        r = B.int_to_scalar(jnp.asarray(r_int))
-        rs_zv = B.fn_mul_plain(r, jnp.asarray(proof.zv)).reshape(
+        r = B.int_to_scalar(jnp.asarray(r_int, dtype=jnp.int64))
+        rs_zv = B.fn_mul_plain(r, jnp.asarray(proof.zv, dtype=jnp.uint32)).reshape(
             -1, params.NUM_LIMBS)
         S = B.tree_reduce_add(rs_zv, B.fn_add, axis=0)
         gtb_pow_s = gt_pow_gtb(S[None])[0]
@@ -923,7 +923,7 @@ def create_range_proof_list(key, secrets, rs, cts, ranges,
         key, sub = jax.random.split(key)
         ia = np.asarray(idx, dtype=np.int64)
         pb = create_range_proofs(
-            sub, secrets[ia], jnp.asarray(rs)[ia], jnp.asarray(cts)[ia],
+            sub, secrets[ia], jnp.asarray(rs, dtype=jnp.uint32)[ia], jnp.asarray(cts, dtype=jnp.uint32)[ia],
             sigs_by_u[u], u, l, ca_pub_table)
         batches.append((ia, pb))
     return RangeProofList(n_values=len(ranges), batches=batches)
@@ -938,9 +938,9 @@ def _slice_batch(pb: RangeProofBatch, sel: np.ndarray) -> RangeProofBatch:
                     pb.n_values, 128)[ns],
                 "d": pb.wire["d"][ns], "v": pb.wire["v"][:, ns],
                 "a": pb.wire["a"][:, ns]}
-    sel = jnp.asarray(sel)
+    sel = jnp.asarray(sel)  # drynx: noqa[implicit-dtype]  (generic index array)
     return RangeProofBatch(
-        commit=jnp.asarray(pb.commit)[sel], challenge=pb.challenge[sel],
+        commit=jnp.asarray(pb.commit, dtype=jnp.uint32)[sel], challenge=pb.challenge[sel],
         zr=pb.zr[sel], d=pb.d[sel], zphi=pb.zphi[sel],
         zv=pb.zv[:, sel], v_pts=pb.v_pts[:, sel], a=pb.a[:, sel],
         u=pb.u, l=pb.l, wire=wire)
@@ -964,8 +964,8 @@ def create_range_proof_lists_batched(key, secrets_2d, rs_2d, cts_2d, ranges,
     n_dps, V = secrets_2d.shape
     flat_ranges = list(ranges) * n_dps
     big = create_range_proof_list(
-        key, secrets_2d.reshape(-1), jnp.asarray(rs_2d).reshape(-1, 16),
-        jnp.asarray(cts_2d).reshape(-1, 2, 3, 16), flat_ranges, sigs_by_u,
+        key, secrets_2d.reshape(-1), jnp.asarray(rs_2d, dtype=jnp.uint32).reshape(-1, 16),
+        jnp.asarray(cts_2d, dtype=jnp.uint32).reshape(-1, 2, 3, 16), flat_ranges, sigs_by_u,
         ca_pub_table)
     out = []
     for d in range(n_dps):
@@ -1066,7 +1066,7 @@ def _concat_batches(pbs: list) -> RangeProofBatch:
     """Concatenate same-spec batches along the value axis."""
     u, l = pbs[0].u, pbs[0].l
     assert all(pb.u == u and pb.l == l for pb in pbs)
-    cat = lambda xs, ax: jnp.concatenate([jnp.asarray(x) for x in xs], ax)
+    cat = lambda xs, ax: jnp.concatenate([jnp.asarray(x, dtype=jnp.uint32) for x in xs], ax)
     wire = None
     if all(pb.wire is not None for pb in pbs):
         wire = {"commit": np.concatenate(

@@ -99,8 +99,8 @@ def verify_batch(pubs, msgs: list[bytes], sigs: list[Signature]) -> np.ndarray:
         ry = int.from_bytes(sg.r_bytes[32:], "big")
         Rs[i] = C.from_ref(None if rx == 0 and ry == 0 else (rx, ry))
         Ps[i] = C.from_ref(p)
-    lhs = eg.fixed_base_mul(eg.BASE_TABLE.table, jnp.asarray(ss))
-    rhs = C.add(jnp.asarray(Rs), C.scalar_mul(jnp.asarray(Ps), jnp.asarray(cs)))
+    lhs = eg.fixed_base_mul(eg.BASE_TABLE.table, jnp.asarray(ss, dtype=jnp.uint32))
+    rhs = C.add(jnp.asarray(Rs, dtype=jnp.uint32), C.scalar_mul(jnp.asarray(Ps, dtype=jnp.uint32), jnp.asarray(cs, dtype=jnp.uint32)))
     return np.asarray(C.eq(lhs, rhs))
 
 

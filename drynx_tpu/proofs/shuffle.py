@@ -59,13 +59,13 @@ def _msm(points, scalars_int) -> jnp.ndarray:
     """
     from ..crypto import batching as B
 
-    ks = jnp.asarray(np.stack([F.from_int(s % N) for s in scalars_int]))
+    ks = jnp.asarray(np.stack([F.from_int(s % N) for s in scalars_int]), dtype=jnp.uint32)
     prods = B.g1_scalar_mul(points, ks)
     return B.tree_reduce_add(prods, B.g1_add)
 
 
 def _base_muls(scalars_int) -> jnp.ndarray:
-    ks = jnp.asarray(np.stack([F.from_int(s % N) for s in scalars_int]))
+    ks = jnp.asarray(np.stack([F.from_int(s % N) for s in scalars_int]), dtype=jnp.uint32)
     return eg.fixed_base_mul(eg.BASE_TABLE.table, ks)
 
 
@@ -116,9 +116,9 @@ def ilmpp_prove(xs: list[int], ys: list[int], X, Y, rng) -> ILMPPProof:
     scal_x = [0] + thetas            # coefficient of X_i in A_i
     scal_y = thetas + [0]            # coefficient of Y_i in A_i
     Ax = C.scalar_mul(X, jnp.asarray(np.stack(
-        [F.from_int(s % N) for s in scal_x])))
+        [F.from_int(s % N) for s in scal_x]), dtype=jnp.uint32))
     Ay = C.scalar_mul(Y, jnp.asarray(np.stack(
-        [F.from_int(s % N) for s in scal_y])))
+        [F.from_int(s % N) for s in scal_y]), dtype=jnp.uint32))
     commits = C.add(Ax, Ay)
 
     c = _hash_points_to_scalars(1, X, Y, commits)[0]
@@ -149,9 +149,9 @@ def ilmpp_verify(proof: ILMPPProof, X, Y) -> bool:
     scal_x = [c] + r[: m - 1]
     scal_y = r[: m - 1] + [sign_m * c]
     Ax = C.scalar_mul(X, jnp.asarray(np.stack(
-        [F.from_int(s % N) for s in scal_x])))
+        [F.from_int(s % N) for s in scal_x]), dtype=jnp.uint32))
     Ay = C.scalar_mul(Y, jnp.asarray(np.stack(
-        [F.from_int(s % N) for s in scal_y])))
+        [F.from_int(s % N) for s in scal_y]), dtype=jnp.uint32))
     expect = C.add(Ax, Ay)
     return bool(np.all(np.asarray(C.eq(expect, proof.commits))))
 
@@ -214,7 +214,7 @@ def prove_shuffle(in_cts, out_cts, perm, betas_int, h_pt,
 
     # SimpleShuffle via ILMPP over 2k: (e_i·G ‖ Γ×k) vs (Y_j ‖ G×k)
     e_pts = _base_muls(e)
-    ones = jnp.broadcast_to(jnp.asarray(C.from_ref(refimpl.G1)),
+    ones = jnp.broadcast_to(jnp.asarray(C.from_ref(refimpl.G1), dtype=jnp.uint32),
                             (k, 3, e_pts.shape[-1]))
     gammas = jnp.broadcast_to(gamma_pt, (k, 3, e_pts.shape[-1]))
     X_seq = jnp.concatenate([e_pts, gammas], axis=0)
@@ -236,11 +236,11 @@ def prove_shuffle(in_cts, out_cts, perm, betas_int, h_pt,
     t_pts = _base_muls(th_y)
     t_gamma = _base_muls([th_g])[0]
     t_a = C.add(_msm(A_out, th_y),
-                C.neg(C.add(C.scalar_mul(SA, jnp.asarray(F.from_int(th_g))),
+                C.neg(C.add(C.scalar_mul(SA, jnp.asarray(F.from_int(th_g), dtype=jnp.uint32)),
                             _base_muls([th_s])[0])))
     t_b = C.add(_msm(B_out, th_y),
-                C.neg(C.add(C.scalar_mul(SB, jnp.asarray(F.from_int(th_g))),
-                            C.scalar_mul(h_pt, jnp.asarray(F.from_int(th_s))))))
+                C.neg(C.add(C.scalar_mul(SB, jnp.asarray(F.from_int(th_g), dtype=jnp.uint32)),
+                            C.scalar_mul(h_pt, jnp.asarray(F.from_int(th_s), dtype=jnp.uint32)))))
 
     c = _hash_points_to_scalars(
         1, y_pts, gamma_pt[None], t_pts, t_gamma[None], t_a[None],
@@ -262,7 +262,7 @@ def verify_shuffle(proof: ShuffleProof, in_cts, out_cts, h_pt) -> bool:
     # 1. SimpleShuffle part
     e_pts = _base_muls(e)
     nl = e_pts.shape[-1]
-    ones = jnp.broadcast_to(jnp.asarray(C.from_ref(refimpl.G1)), (k, 3, nl))
+    ones = jnp.broadcast_to(jnp.asarray(C.from_ref(refimpl.G1), dtype=jnp.uint32), (k, 3, nl))
     gammas = jnp.broadcast_to(proof.gamma_pt, (k, 3, nl))
     X_seq = jnp.concatenate([e_pts, gammas], axis=0)
     Y_seq = jnp.concatenate([proof.y_pts, ones], axis=0)
@@ -278,27 +278,27 @@ def verify_shuffle(proof: ShuffleProof, in_cts, out_cts, h_pt) -> bool:
 
     z_pts = _base_muls(proof.z)
     rhs_y = C.add(proof.t_pts, C.scalar_mul(proof.y_pts,
-                                            jnp.asarray(F.from_int(c))))
+                                            jnp.asarray(F.from_int(c), dtype=jnp.uint32)))
     if not bool(np.all(np.asarray(C.eq(z_pts, rhs_y)))):
         return False
     if not bool(np.all(np.asarray(C.eq(
             _base_muls([proof.z_gamma])[0],
             C.add(proof.t_gamma, C.scalar_mul(proof.gamma_pt,
-                                              jnp.asarray(F.from_int(c)))))))):
+                                              jnp.asarray(F.from_int(c), dtype=jnp.uint32))))))):
         return False
 
     A_in, B_in = in_cts[:, 0], in_cts[:, 1]
     A_out, B_out = out_cts[:, 0], out_cts[:, 1]
     SA = _msm(A_in, e)
     SB = _msm(B_in, e)
-    zg = jnp.asarray(F.from_int(proof.z_gamma))
+    zg = jnp.asarray(F.from_int(proof.z_gamma), dtype=jnp.uint32)
     lhs_a = C.add(_msm(A_out, proof.z),
                   C.neg(C.add(C.scalar_mul(SA, zg),
                               _base_muls([proof.z_s])[0])))
     lhs_b = C.add(_msm(B_out, proof.z),
                   C.neg(C.add(C.scalar_mul(SB, zg),
                               C.scalar_mul(h_pt, jnp.asarray(
-                                  F.from_int(proof.z_s))))))
+                                  F.from_int(proof.z_s), dtype=jnp.uint32)))))
     # relation points are the identity, so lhs == t + c·0 = t
     ok_a = bool(np.all(np.asarray(C.eq(lhs_a, proof.t_a))))
     ok_b = bool(np.all(np.asarray(C.eq(lhs_b, proof.t_b))))

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Fast pre-commit gate: static analyzer + the quick tier-1 tests.
+# ~3 min on the 1-core CI box. Full suite: python scripts/run_suite.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== static analysis (python -m drynx_tpu.analysis) =="
+python -m drynx_tpu.analysis drynx_tpu/ "$@"
+
+echo "== quick tests =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
+    tests/test_static_analysis.py \
+    tests/test_analysis_rules.py \
+    tests/test_field.py \
+    tests/test_refimpl.py \
+    tests/test_batching.py \
+    tests/test_service_vn.py \
+    tests/test_datasets_timedata.py
+
+echo "check.sh: all green"

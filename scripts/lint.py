@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Thin wrapper: `python scripts/lint.py [args...]` == `python -m
+drynx_tpu.analysis [args...]`. Exists so the lint entrypoint is
+discoverable next to the other repo scripts; see ANALYSIS.md.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from drynx_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,286 @@
+"""Per-rule unit tests for drynx_tpu.analysis: each rule gets synthetic
+positive and negative snippets driven through ``analyze_source`` — the
+analyzer never touches the real tree here (that gate lives in
+tests/test_static_analysis.py). No jax import; runs in milliseconds.
+"""
+import textwrap
+
+import pytest
+
+from drynx_tpu.analysis import (BaselineEntry, analyze_source,
+                                apply_baseline)
+
+pytestmark = pytest.mark.lint
+
+CRYPTO = "drynx_tpu/crypto/synthetic.py"
+PROOFS = "drynx_tpu/proofs/synthetic.py"
+PARALLEL = "drynx_tpu/parallel/synthetic.py"
+ELSEWHERE = "drynx_tpu/network/synthetic.py"
+
+
+def run(src, relpath=CRYPTO, rule=None):
+    return analyze_source(textwrap.dedent(src), relpath,
+                          rules=[rule] if rule else None)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- jit-global-capture -----------------------------------------------------
+
+JIT_FLAG = """
+    import os
+    import jax
+
+    FLAG = os.environ.get("SYNTH_FLAG", "0") == "1"
+
+    @jax.jit
+    def f(x):
+        if FLAG:
+            return x
+        return x + 1
+"""
+
+
+def test_jit_global_capture_fires_on_env_flag_in_jit():
+    found = run(JIT_FLAG, rule="jit-global-capture")
+    assert len(found) == 1
+    assert "FLAG" in found[0].message and "'f'" in found[0].message
+
+
+def test_jit_global_capture_fires_in_pallas_builder():
+    src = """
+        from drynx_tpu.crypto.pallas_ops import INTERPRET
+        import jax.experimental.pallas as pl
+
+        def builder(x):
+            return pl.pallas_call(_k, interpret=INTERPRET)(x)
+    """
+    found = run(src, rule="jit-global-capture")
+    assert len(found) == 1 and "INTERPRET" in found[0].message
+
+
+def test_jit_global_capture_ignores_local_shadow_and_constants():
+    src = """
+        import os
+        import jax
+
+        FLAG = os.environ.get("SYNTH_FLAG", "0") == "1"
+        LIMBS = 16  # plain constant: not env-derived, never rebound
+
+        @jax.jit
+        def f(x):
+            FLAG = False
+            return x + LIMBS if FLAG else x
+    """
+    assert run(src, rule="jit-global-capture") == []
+
+
+def test_jit_global_capture_ignores_untraced_functions():
+    src = """
+        import os
+
+        FLAG = os.environ.get("SYNTH_FLAG", "0") == "1"
+
+        def plain(x):
+            return x if FLAG else -x
+    """
+    assert run(src, rule="jit-global-capture") == []
+
+
+# -- unsafe-pickle ----------------------------------------------------------
+
+def test_unsafe_pickle_flags_loads_and_from_import():
+    src = """
+        import pickle
+        from pickle import loads as _loads
+
+        def a(b):
+            return pickle.loads(b)
+
+        def c(b):
+            return _loads(b)
+    """
+    found = run(src, rule="unsafe-pickle")
+    assert len(found) == 2
+
+
+def test_unsafe_pickle_allows_dumps_and_safe_pickle_module():
+    assert run("import pickle\nblob = pickle.dumps([1])\n",
+               rule="unsafe-pickle") == []
+    bad = "import pickle\nx = pickle.loads(b'')\n"
+    assert run(bad, relpath="drynx_tpu/proofs/safe_pickle.py",
+               rule="unsafe-pickle") == []
+    # ... but the same code anywhere else is flagged
+    assert len(run(bad, rule="unsafe-pickle")) == 1
+
+
+# -- implicit-dtype ---------------------------------------------------------
+
+def test_implicit_dtype_flags_bare_ctors_in_crypto_and_proofs():
+    src = "import jax.numpy as jnp\nx = jnp.zeros((4,))\n"
+    assert len(run(src, relpath=CRYPTO, rule="implicit-dtype")) == 1
+    assert len(run(src, relpath=PROOFS, rule="implicit-dtype")) == 1
+
+
+def test_implicit_dtype_accepts_keyword_or_positional_dtype():
+    src = """
+        import jax.numpy as jnp
+        a = jnp.zeros((4,), dtype=jnp.uint32)
+        b = jnp.zeros((4,), jnp.uint32)
+        c = jnp.full((4,), 7, jnp.uint32)
+    """
+    assert run(src, rule="implicit-dtype") == []
+
+
+def test_implicit_dtype_is_scoped_to_crypto_and_proofs():
+    src = "import jax.numpy as jnp\nx = jnp.zeros((4,))\n"
+    assert run(src, relpath=ELSEWHERE, rule="implicit-dtype") == []
+
+
+# -- host-sync-in-hot-path --------------------------------------------------
+
+def test_host_sync_flags_cast_of_traced_value():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x + 1
+            return float(y)
+    """
+    found = run(src, rule="host-sync-in-hot-path")
+    assert len(found) == 1 and "float" in found[0].message
+
+
+def test_host_sync_flags_block_until_ready_in_parallel():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return (x + 1).block_until_ready()
+    """
+    found = run(src, relpath=PARALLEL, rule="host-sync-in-hot-path")
+    assert len(found) == 1
+
+
+def test_host_sync_ignores_static_args_and_untraced_code():
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            return x * int(n)
+
+        def host_helper(x):
+            return float(x)
+    """
+    assert run(src, rule="host-sync-in-hot-path") == []
+
+
+# -- env-read-into-trace ----------------------------------------------------
+
+def test_env_read_fires_only_when_value_reaches_a_trace():
+    found = run(JIT_FLAG, rule="env-read-into-trace")
+    assert len(found) == 1
+    assert "FLAG" in found[0].message and "f" in found[0].message
+
+    unused_in_trace = """
+        import os
+
+        FLAG = os.environ.get("SYNTH_FLAG", "0") == "1"
+
+        def host_only():
+            return FLAG
+    """
+    assert run(unused_in_trace, rule="env-read-into-trace") == []
+
+
+def test_env_read_fires_on_direct_read_inside_jit():
+    src = """
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            if os.environ.get("SYNTH_FLAG"):
+                return x
+            return -x
+    """
+    found = run(src, rule="env-read-into-trace")
+    assert len(found) == 1 and "trace time" in found[0].message
+
+
+# -- secret-logging ---------------------------------------------------------
+
+def test_secret_logging_flags_prints_and_loggers():
+    src = """
+        import logging
+        log = logging.getLogger(__name__)
+
+        def leak(secret_key, keys):
+            print(secret_key)
+            log.info("scalar %s", keys.sk)
+    """
+    found = run(src, rule="secret-logging")
+    assert len(found) == 2
+
+
+def test_secret_logging_ignores_public_material():
+    src = """
+        def fine(pub_key, ciphertext):
+            print(pub_key, ciphertext)
+    """
+    assert run(src, rule="secret-logging") == []
+
+
+# -- suppression + baseline mechanics ---------------------------------------
+
+def test_noqa_suppresses_named_rule_only():
+    src = ("import jax.numpy as jnp\n"
+           "x = jnp.zeros((4,))  # drynx: noqa[implicit-dtype]\n"
+           "y = jnp.zeros((4,))  # drynx: noqa[unsafe-pickle]\n")
+    found = run(src, rule="implicit-dtype")
+    assert [f.line for f in found] == [3]
+
+
+def test_bare_noqa_suppresses_everything_on_the_line():
+    src = ("import jax.numpy as jnp\n"
+           "x = jnp.zeros((4,))  # drynx: noqa\n")
+    assert run(src, rule="implicit-dtype") == []
+
+
+def test_parse_error_becomes_a_finding():
+    found = analyze_source("def broken(:\n", CRYPTO)
+    assert [f.rule for f in found] == ["parse-error"]
+
+
+def test_baseline_matches_by_line_text_and_respects_count():
+    src = ("import jax.numpy as jnp\n"
+           "x = jnp.zeros((4,))\n"
+           "y = jnp.zeros((4,))\n")
+    found = run(src, rule="implicit-dtype")
+    assert len(found) == 2
+
+    def entry(count, line_text="x = jnp.zeros((4,))"):
+        return BaselineEntry(rule="implicit-dtype", file=CRYPTO,
+                             line_text=line_text, count=count,
+                             why="synthetic")
+
+    # exact grandfathering: both lines baselined -> clean, nothing stale
+    un, matched, stale = apply_baseline(
+        found, [entry(1), entry(1, "y = jnp.zeros((4,))")])
+    assert (un, matched, stale) == ([], 2, [])
+
+    # under-budget: one of the two stays unbaselined
+    un, matched, stale = apply_baseline(
+        found, [entry(1)])
+    assert matched == 1 and len(un) == 1 and not stale
+
+    # stale: baseline names a line that no longer exists
+    un, matched, stale = apply_baseline(
+        found, [entry(1, "z = jnp.zeros((9,))")])
+    assert len(stale) == 1 and len(un) == 2

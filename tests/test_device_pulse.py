@@ -20,6 +20,7 @@ Reference analogue: kyber's arithmetic is exercised by every Go test; ours
 must not go a round with the compiled path unexecuted.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -39,6 +40,11 @@ RNG = np.random.default_rng(41)
 def interpret_kernels(monkeypatch):
     monkeypatch.setattr(po, "INTERPRET", True)
     monkeypatch.setattr(pp, "INTERPRET", True)
+    yield
+    # INTERPRET is baked into the jit/pallas trace cache at trace time
+    # (keyed only on shapes/static args), so traces built here would leak
+    # interpret-mode kernels into later tests. Drop them on the way out.
+    jax.clear_caches()
 
 
 def _rfp() -> int:

@@ -10,6 +10,7 @@ reduced pairing against refimpl.pair.
 import os
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -38,6 +39,11 @@ RNG = np.random.default_rng(23)
 def interpret_mode(monkeypatch):
     monkeypatch.setattr(po, "INTERPRET", True)
     monkeypatch.setattr(pp, "INTERPRET", True)
+    yield
+    # Traces cached while INTERPRET was patched would survive the
+    # monkeypatch undo (jit caches key on shapes, not globals); clear
+    # them so later tests recompile against the real setting.
+    jax.clear_caches()
 
 
 def rfp():

@@ -1,0 +1,81 @@
+"""Tier-1 gate: the static analyzer must be clean over drynx_tpu/.
+
+Runs the AST lint pass (drynx_tpu.analysis, see ANALYSIS.md) against the
+committed tree and asserts zero unbaselined findings, a healthy baseline
+(no stale entries, every entry justified), and that the CLI actually
+fails on a violation — so the gate can't rot into a tautology.
+
+Marked `lint`: `pytest -m lint` runs just this file in seconds. The
+analysis package deliberately imports no jax, so this test stays alive
+even when the accelerator stack is broken.
+"""
+import subprocess
+import sys
+
+import pytest
+
+from drynx_tpu.analysis import (DEFAULT_BASELINE, REPO_ROOT, RULES,
+                                analyze_paths, apply_baseline, load_baseline)
+
+pytestmark = pytest.mark.lint
+
+PACKAGE = REPO_ROOT / "drynx_tpu"
+
+
+def test_registry_has_the_documented_rules():
+    expected = {"jit-global-capture", "unsafe-pickle", "implicit-dtype",
+                "host-sync-in-hot-path", "env-read-into-trace",
+                "secret-logging"}
+    assert expected <= set(RULES), sorted(expected - set(RULES))
+
+
+def test_tree_is_clean_modulo_baseline():
+    findings = analyze_paths([PACKAGE])
+    baseline = load_baseline(DEFAULT_BASELINE)
+    unbaselined, matched, stale = apply_baseline(findings, baseline)
+    assert not unbaselined, "unbaselined findings:\n" + "\n".join(
+        f.render() for f in unbaselined)
+    assert not stale, ("stale baseline entries (prune LINT_BASELINE.json):"
+                       "\n" + "\n".join(f"[{e.rule}] {e.file}: "
+                                        f"{e.line_text!r}" for e in stale))
+    assert matched > 0  # the baseline documents real grandfathered debt
+
+
+def test_every_baseline_entry_is_justified():
+    for e in load_baseline(DEFAULT_BASELINE):
+        assert e.why.strip(), f"baseline entry without a why: {e.file} " \
+                              f"[{e.rule}] {e.line_text!r}"
+        assert e.count >= 1
+
+
+VIOLATION = (
+    "import pickle\n"
+    "def load(blob):\n"
+    "    return pickle.loads(blob)\n"
+)
+
+
+def _cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def test_cli_exits_zero_on_the_tree():
+    proc = _cli([str(PACKAGE)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_fails_on_a_synthetic_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(VIOLATION)
+    proc = _cli([str(bad)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "unsafe-pickle" in proc.stdout
+
+
+def test_cli_passes_a_clean_file(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("import numpy as np\n\nX = np.zeros((4,), np.uint32)\n")
+    proc = _cli([str(ok)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
