@@ -328,3 +328,92 @@ class SecretLogging(Rule):
                 if name and _SECRET_RE.search(name):
                     return name
         return ""
+
+
+# ---------------------------------------------------------------------------
+@register
+class HardcodedTimeout(Rule):
+    """Retry/timeout numbers scattered as bare literals made failure
+    behavior unauditable: nobody could say how long a dead DP stalls a
+    survey without reading every call site (the pre-resilience state of
+    node.py/api.py/service.py). Every such number must be a named constant
+    in drynx_tpu/resilience/policy.py — that module is the single place
+    the rule exempts. Fires on: timeout=/retries= keyword literals,
+    timeout-ish parameter defaults, sleep/wait calls with literal
+    durations, and `.get("...timeout...", <literal>)` fallbacks."""
+
+    id = "hardcoded-timeout"
+    summary = ("bare numeric timeout/retry literal outside "
+               "drynx_tpu/resilience/ — name it in resilience/policy.py")
+
+    _SLEEPY = {"sleep", "wait", "join"}
+
+    @staticmethod
+    def _timeoutish(name: str) -> bool:
+        n = name.lower()
+        return ("timeout" in n or n == "retries" or n.endswith("_retries")
+                or n.endswith("deadline"))
+
+    @staticmethod
+    def _nonzero_num(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Constant)
+                and isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool)
+                and node.value != 0)
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not _is_drynx_pkg(mod) or _in_scope(mod, "resilience"):
+            return
+        for sub in ast.walk(mod.tree):
+            if isinstance(sub, ast.Call):
+                yield from self._check_call(mod, sub)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_defaults(mod, sub)
+
+    def _check_call(self, mod: ModuleInfo, call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg and self._timeoutish(kw.arg) \
+                    and self._nonzero_num(kw.value):
+                yield self.finding(
+                    mod, call,
+                    f"literal {kw.arg}={kw.value.value!r} — use a named "
+                    f"constant from drynx_tpu/resilience/policy.py")
+                return
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in self._SLEEPY and call.args \
+                    and self._nonzero_num(call.args[0]):
+                yield self.finding(
+                    mod, call,
+                    f"literal duration in '.{call.func.attr}"
+                    f"({call.args[0].value!r})' — use a named constant "
+                    f"from drynx_tpu/resilience/policy.py")
+                return
+            if (call.func.attr == "get" and len(call.args) >= 2
+                    and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, str)
+                    and self._timeoutish(call.args[0].value)
+                    and self._nonzero_num(call.args[1])):
+                yield self.finding(
+                    mod, call,
+                    f"literal fallback in .get({call.args[0].value!r}, "
+                    f"{call.args[1].value!r}) — use a named constant from "
+                    f"drynx_tpu/resilience/policy.py")
+
+    def _check_defaults(self, mod: ModuleInfo, fn):
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            if self._timeoutish(a.arg) and self._nonzero_num(d):
+                yield self.finding(
+                    mod, d,
+                    f"literal default {a.arg}={d.value!r} in '{fn.name}' — "
+                    f"use a named constant from "
+                    f"drynx_tpu/resilience/policy.py")
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and self._timeoutish(a.arg) \
+                    and self._nonzero_num(d):
+                yield self.finding(
+                    mod, d,
+                    f"literal default {a.arg}={d.value!r} in '{fn.name}' — "
+                    f"use a named constant from "
+                    f"drynx_tpu/resilience/policy.py")

@@ -152,11 +152,14 @@ def cmd_survey_run(args) -> int:
         # fixed-point coefficients inside the proved range
         ranges = [(16, 5)] * lr_params.num_coeffs()
     if sv.get("proofs"):
+        from ..resilience import policy as rp
+
         result, block = client.run_survey(
             op, query_min=qmin, query_max=qmax, proofs=True,
             obfuscation=bool(sv.get("obfuscation", False)),
             lr_params=lr_params, ranges=ranges,
-            timeout=float(sv.get("proof_timeout", 4800.0)))
+            timeout=float(sv.get("proof_timeout",
+                                 2 * rp.COLD_COMPILE_WAIT_S)))
         bitmap = block.get("bitmap", {})
         print(json.dumps({"operation": op, "result": _jsonable(result),
                           "block_hash": block.get("block_hash"),

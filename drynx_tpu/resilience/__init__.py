@@ -1,0 +1,22 @@
+"""Control-plane resilience: fault injection, retry policy, quorum knobs.
+
+The reference Drynx stack targets *Byzantine* faults with ZK proofs but
+leaves crash/availability faults unhandled — a survey is one-shot and a
+failed node aborts it (SURVEY.md §"Failure detection"). This package is
+the availability half: a seeded deterministic fault-injection layer for
+the TCP control plane (:mod:`.faults`), and one place where every retry,
+backoff, and timeout number lives (:mod:`.policy`). The lint rule
+``hardcoded-timeout`` (drynx_tpu/analysis/rules.py) keeps it that way:
+bare timeout/retry literals outside this package fail CI.
+
+Quorum semantics (the third leg — degraded surveys over the DPs/VNs that
+actually answered) live where the survey runs: ``service/node.py``
+(`_h_survey_query`, `_h_end_verification`) and ``service/service.py``
+(LocalCluster), parameterized by ``SurveyQuery.min_dp_quorum`` /
+``SurveyQuery.vn_quorum``. ROBUSTNESS.md documents the whole model.
+"""
+from .faults import FaultPlan, FaultSpec, fault_plan, set_fault_plan
+from .policy import DEFAULT_POLICY, RetryPolicy, is_idempotent
+
+__all__ = ["FaultPlan", "FaultSpec", "fault_plan", "set_fault_plan",
+           "RetryPolicy", "DEFAULT_POLICY", "is_idempotent"]

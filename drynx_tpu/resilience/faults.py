@@ -1,0 +1,187 @@
+"""Seeded, deterministic fault injection for the TCP control plane.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` rules plus a seed.
+``service/transport.py`` consults the process-global plan at four hook
+points — the same module-global pattern as its ``LinkModel``:
+
+  where="connect"  client side, before the TCP connect     (refuse, delay)
+  where="request"  client side, around sending one frame   (drop, delay,
+                                                            corrupt,
+                                                            close_mid_frame)
+  where="reply"    server side, around sending the reply   (same kinds —
+                                                            "the peer died
+                                                            mid-answer")
+  where="node"     server side, the whole node             (kill, pause)
+
+Determinism: each spec owns an ``np.random.default_rng((plan.seed, i))``
+stream, so whether a probabilistic spec fires depends only on the plan
+seed and the *order* of matching events — which the sequential survey
+dispatch makes reproducible. Node-level verdicts are memoized per
+(spec, node) so "is dp3 dead?" never flips mid-run. Two runs with the
+same plan seed and the same traffic order take identical fault decisions
+(asserted in tests/test_resilience.py).
+
+No transport import here (transport imports *us*); no jax import either —
+like the analysis package, chaos tooling must work when the accelerator
+stack is broken.
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import threading
+from typing import Optional
+
+import numpy as np
+
+KINDS = ("refuse", "drop", "delay", "close_mid_frame", "corrupt",
+         "kill", "pause")
+WHERES = ("connect", "request", "reply", "node")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One fault rule. ``target`` is an fnmatch pattern over node names
+    ("dp3", "dp*", "*"); ``mtype`` filters by message type for
+    request/reply hooks ("*" = any). ``prob`` gates each firing through
+    the spec's seeded stream; ``count`` caps total firings (None =
+    unlimited). ``delay_s`` parameterizes delay/pause."""
+
+    where: str
+    kind: str
+    target: str = "*"
+    mtype: str = "*"
+    prob: float = 1.0
+    count: Optional[int] = None
+    delay_s: float = 0.0
+    fired: int = 0     # mutated under the plan lock
+
+    def __post_init__(self):
+        if self.where not in WHERES:
+            raise ValueError(f"unknown fault hook {self.where!r}")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind in ("kill", "pause") and self.where != "node":
+            raise ValueError(f"{self.kind!r} is a node-level fault")
+
+    def matches(self, target: str, mtype: str) -> bool:
+        return (fnmatch.fnmatchcase(target, self.target)
+                and (self.mtype == "*" or self.mtype == mtype))
+
+
+class FaultPlan:
+    """A seeded set of fault rules + an explicit kill set.
+
+    Thread-safe: transport handler threads and client threads consult the
+    plan concurrently; all draw/counter state mutates under one lock.
+    """
+
+    def __init__(self, seed: int = 0, specs=()):
+        self.seed = int(seed)
+        self.specs: list[FaultSpec] = []
+        self._rngs: list[np.random.Generator] = []
+        self._killed: set[str] = set()
+        self._node_verdicts: dict[tuple[int, str], bool] = {}
+        self._lock = threading.Lock()
+        for s in specs:
+            self.add(s)
+
+    def add(self, spec: FaultSpec) -> FaultSpec:
+        with self._lock:
+            self.specs.append(spec)
+            self._rngs.append(
+                np.random.default_rng((self.seed, len(self.specs) - 1)))
+        return spec
+
+    # -- node-level state ------------------------------------------------
+    def kill(self, name: str) -> None:
+        """Hard-kill: the node's server closes every connection without
+        answering, and clients refuse to dial it."""
+        with self._lock:
+            self._killed.add(name)
+
+    def revive(self, name: str) -> None:
+        with self._lock:
+            self._killed.discard(name)
+
+    def killed(self, name: str) -> bool:
+        with self._lock:
+            if name in self._killed:
+                return True
+            return self._node_verdict(name, "kill") is not None
+
+    def node_fault(self, name: str) -> Optional[FaultSpec]:
+        """The node-level spec (kill or pause) applying to ``name``, if
+        any. Verdicts are drawn once per (spec, node) and memoized — a
+        node is dead or alive for the whole run, never flapping."""
+        with self._lock:
+            if name in self._killed:
+                return FaultSpec(where="node", kind="kill", target=name)
+            for kind in ("kill", "pause"):
+                s = self._node_verdict(name, kind)
+                if s is not None:
+                    return s
+        return None
+
+    def _node_verdict(self, name: str, kind: str) -> Optional[FaultSpec]:
+        # caller holds the lock
+        for i, s in enumerate(self.specs):
+            if s.where != "node" or s.kind != kind:
+                continue
+            if not s.matches(name, "*"):
+                continue
+            key = (i, name)
+            if key not in self._node_verdicts:
+                self._node_verdicts[key] = (
+                    s.prob >= 1.0 or float(self._rngs[i].random()) < s.prob)
+            if self._node_verdicts[key]:
+                return s
+        return None
+
+    # -- link-level draws ------------------------------------------------
+    def pick(self, where: str, target: str,
+             mtype: str = "*") -> Optional[FaultSpec]:
+        """First matching link-level spec that fires for this event, with
+        its counter consumed. Every matching probabilistic spec advances
+        its stream exactly once per event, fired or not, so the draw
+        sequence depends only on traffic order."""
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if s.where != where or s.where == "node":
+                    continue
+                if not s.matches(target, mtype):
+                    continue
+                if s.count is not None and s.fired >= s.count:
+                    continue
+                fires = (s.prob >= 1.0
+                         or float(self._rngs[i].random()) < s.prob)
+                if fires:
+                    s.fired += 1
+                    return s
+        return None
+
+    def describe(self) -> str:
+        with self._lock:
+            rows = [f"{s.where}/{s.kind} target={s.target} mtype={s.mtype} "
+                    f"p={s.prob} fired={s.fired}" for s in self.specs]
+            if self._killed:
+                rows.append(f"killed={sorted(self._killed)}")
+        return f"FaultPlan(seed={self.seed}): " + ("; ".join(rows) or "empty")
+
+
+# Process-global active plan, mirroring transport's LinkModel pattern.
+# None (the default) means every hook is a no-op.
+_PLAN: Optional[FaultPlan] = None
+
+
+def fault_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+__all__ = ["FaultSpec", "FaultPlan", "fault_plan", "set_fault_plan",
+           "KINDS", "WHERES"]

@@ -1,0 +1,127 @@
+"""The control plane's single source of truth for retry/backoff/timeout
+numbers, plus the per-message-type idempotency table.
+
+Before this module existed the service layer carried ad-hoc literals
+(``retries=2``, ``time.sleep(0.2 * (attempt + 1))``, ``timeout=300.0`` /
+``900.0`` / ``2400`` scattered over node.py/api.py/service.py), which made
+failure behavior unauditable: nobody could say how long a dead DP stalls a
+survey without reading every call site. Every named constant below is
+referenced from those call sites instead; the ``hardcoded-timeout`` lint
+rule (drynx_tpu/analysis/rules.py) rejects new bare literals outside
+``drynx_tpu/resilience/``.
+
+Idempotency contract (see ROBUSTNESS.md for the full table): a message may
+be re-sent after a transport failure only when re-executing its handler is
+harmless. Connection *establishment* always retries. Contribution
+handlers (survey_dp, obf/shuffle/ks_contrib, proof_request, survey_query,
+end_verification) mutate per-survey state or re-randomize ciphertexts —
+once any bytes of the request have been written, a failure must surface,
+never silently re-send (the reference has the same asymmetry: onet retries
+dials, not protocol messages).
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+# -- named timeout/retry constants (seconds unless suffixed otherwise) ------
+# Connection establishment: cheap, always safe to retry.
+CONNECT_RETRIES = 2
+CONNECT_BACKOFF_S = 0.2          # base of the exponential backoff
+BACKOFF_CAP_S = 5.0              # backoff never exceeds this per attempt
+BACKOFF_JITTER = 0.25            # +/- fraction of the base applied per draw
+
+# One request/response on an established connection. Generous because a
+# cold CPU process compiles proof kernels for minutes while the peer waits.
+CALL_TIMEOUT_S = 900.0
+
+# Health probe: a ping handler answers from the server accept loop with no
+# device work, so a node that can't answer quickly is effectively down.
+PING_TIMEOUT_S = 5.0
+
+# VN-side waits: how long a blocking vn_bitmap / end_verification holds for
+# the expected-proof counter to drain.
+VERIFY_WAIT_S = 300.0
+# Root CN: drain its own async proof-delivery threads before replying.
+PROOF_DRAIN_S = 300.0
+# Extra socket budget layered over a remote peer's blocking wait so the
+# transport timeout always outlives the application timeout it wraps.
+STRAGGLER_GRACE_S = 60.0
+# In-process VNGroup wait (LocalCluster path).
+VN_GROUP_WAIT_S = 60.0
+# Polling granularity for quorum waits (VNGroup watches n done-events).
+POLL_INTERVAL_S = 0.05
+# First run of a proofs-on survey in a fresh CPU process pays all pairing
+# kernel compiles (tens of minutes at opt-level 0 on one core).
+COLD_COMPILE_WAIT_S = 2400.0
+# Client-side end_verification default (api.py).
+END_VERIFICATION_TIMEOUT_S = 600.0
+
+# -- idempotency table ------------------------------------------------------
+# Read-only or set-once-overwrite handlers: re-execution is harmless.
+IDEMPOTENT_MTYPES = frozenset({
+    "ping", "set_roster", "vn_register", "vn_bitmap", "vn_adjust",
+    "range_sig", "get_genesis", "get_latest", "get_block", "get_proofs",
+    "close_db",
+})
+# Handlers that mutate survey state / consume entropy / fan out proofs:
+# re-sending after a partial write can double-count a contribution.
+CONTRIBUTION_MTYPES = frozenset({
+    "survey_query", "survey_dp", "obf_contrib", "shuffle_contrib",
+    "ks_contrib", "proof_request", "end_verification",
+})
+
+
+def is_idempotent(mtype: str) -> bool:
+    """Unknown message types default to NOT idempotent: the safe failure
+    mode for a new handler is a surfaced error, not a silent re-send."""
+    return mtype in IDEMPOTENT_MTYPES
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How one control-plane call behaves under failure.
+
+    ``connect_retries`` additional attempts follow a failed connect or a
+    failed *idempotent* call; backoff between attempts is exponential from
+    ``backoff_s`` capped at ``backoff_cap_s``, with +/- ``jitter`` fraction
+    of the base so a roster's worth of clients doesn't retry in lockstep.
+    ``seed`` makes the jitter draws deterministic (chaos tests); None uses
+    OS entropy like any production client would.
+    """
+
+    connect_retries: int = CONNECT_RETRIES
+    backoff_s: float = CONNECT_BACKOFF_S
+    backoff_cap_s: float = BACKOFF_CAP_S
+    jitter: float = BACKOFF_JITTER
+    call_timeout_s: float = CALL_TIMEOUT_S
+    seed: Optional[int] = None
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt`` (0-based)."""
+        base = min(self.backoff_s * (2.0 ** attempt), self.backoff_cap_s)
+        if self.jitter <= 0:
+            return base
+        r = (random.Random(self.seed * 1_000_003 + attempt)
+             if self.seed is not None else random.Random())
+        return base * (1.0 + self.jitter * (2.0 * r.random() - 1.0))
+
+    def attempts_for(self, mtype: str, sent: bool) -> int:
+        """Total attempts allowed for a call in the given state: before any
+        bytes were written the failure is a connect-class failure (always
+        retriable); after, only idempotent messages may go again."""
+        if not sent or is_idempotent(mtype):
+            return self.connect_retries + 1
+        return 1
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+__all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
+           "IDEMPOTENT_MTYPES", "CONTRIBUTION_MTYPES",
+           "CONNECT_RETRIES", "CONNECT_BACKOFF_S", "BACKOFF_CAP_S",
+           "BACKOFF_JITTER", "CALL_TIMEOUT_S", "PING_TIMEOUT_S",
+           "VERIFY_WAIT_S", "PROOF_DRAIN_S", "STRAGGLER_GRACE_S",
+           "VN_GROUP_WAIT_S", "POLL_INTERVAL_S", "COLD_COMPILE_WAIT_S",
+           "END_VERIFICATION_TIMEOUT_S"]

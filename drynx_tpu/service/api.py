@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..resilience import policy as rp
 from .query import DiffPParams, SurveyQuery
 from .service import LocalCluster, SurveyResult
 
@@ -36,8 +37,11 @@ class DrynxClient:
         """Pre-registration happens inside run_survey for the in-process
         cluster; kept for API parity."""
 
-    def send_end_verification(self, survey_id: str, timeout: float = 600.0):
-        return self.cluster.vns.end_verification(survey_id, timeout=timeout)
+    def send_end_verification(self, survey_id: str,
+                              timeout: float = rp.END_VERIFICATION_TIMEOUT_S,
+                              quorum: float = 1.0):
+        return self.cluster.vns.end_verification(survey_id, timeout=timeout,
+                                                 quorum=quorum)
 
     def get_genesis(self):
         return self.cluster.vns.root.chain.genesis()

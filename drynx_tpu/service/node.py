@@ -27,6 +27,8 @@ proofs at the CNs):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import math
 import pickle
 import secrets
 import threading
@@ -51,10 +53,12 @@ from ..proofs import requests as rq
 from ..proofs import schnorr
 from ..proofs import shuffle as shuffle_proof
 from ..proofs.safe_pickle import safe_loads
+from ..resilience import policy as rp
 from ..utils import log
 from .proof_collection import VerifyingNode
 from .skipchain import DataBlock
-from .transport import Conn, NodeServer, pack_array, unpack_array
+from .transport import (ConnectError, Conn, NodeServer, RemoteError,
+                        TransportError, pack_array, unpack_array)
 
 
 def _pack_bytes(b: bytes) -> dict:
@@ -65,33 +69,49 @@ def _unpack_bytes(d: dict) -> bytes:
     return unpack_array(d).tobytes()
 
 
-def call_entry(entry, msg: dict, retries: int = 2,
-               timeout: float = 900.0) -> dict:
-    """One request/response to a roster entry with CONNECT retry + timeout
+def call_entry(entry, msg: dict, retries: Optional[int] = None,
+               timeout: Optional[float] = None,
+               policy: Optional[rp.RetryPolicy] = None) -> dict:
+    """One request/response to a roster entry under a RetryPolicy
     (the reference leans on onet's connect retry; errors here raise instead
     of log.Fatal-ing the process).
 
-    Only connection ESTABLISHMENT is retried — once the request has been
-    sent, a timeout/reset must not re-execute it (survey_query and the
-    contribution handlers are not idempotent)."""
-    last: Optional[Exception] = None
-    conn = None
-    for attempt in range(retries + 1):
+    Idempotency-aware: connect failures and failed IDEMPOTENT calls
+    (policy.is_idempotent — ping, roster, bitmap reads...) retry with
+    exponential backoff + jitter on a FRESH connection; once any bytes of
+    a non-idempotent request (survey_query, the contribution handlers)
+    have been written, the failure surfaces immediately — a re-send could
+    re-execute the handler. A RemoteError always surfaces: the handler
+    ran, so the transport did its job. ``retries``/``timeout`` override
+    the corresponding policy fields for this one call."""
+    pol = policy or rp.DEFAULT_POLICY
+    if retries is not None:
+        pol = dataclasses.replace(pol, connect_retries=int(retries))
+    if timeout is not None:
+        pol = dataclasses.replace(pol, call_timeout_s=float(timeout))
+    mtype = msg.get("type", "")
+    attempt = 0
+    while True:
+        conn = None
         try:
-            conn = Conn(entry.host, entry.port, timeout=timeout)
-            break
-        except (ConnectionError, OSError) as e:
-            last = e
-            if attempt < retries:
-                time.sleep(0.2 * (attempt + 1))
-    if conn is None:
-        raise ConnectionError(
-            f"node {entry.name} at {entry.host}:{entry.port} unreachable "
-            f"after {retries + 1} attempts: {last!r}")
-    try:
-        return conn.call(msg)
-    finally:
-        conn.close()
+            conn = Conn(entry.host, entry.port,
+                        timeout=pol.call_timeout_s, peer=entry.name)
+            return conn.call(msg)
+        except RemoteError:
+            raise
+        except (TransportError, OSError) as e:
+            sent = conn.sent if conn is not None else False
+            attempt += 1
+            if attempt >= pol.attempts_for(mtype, sent):
+                if sent:
+                    raise
+                raise ConnectError(
+                    f"node {entry.name} at {entry.host}:{entry.port} "
+                    f"unreachable after {attempt} attempts: {e!r}") from e
+            time.sleep(pol.backoff(attempt - 1))
+        finally:
+            if conn is not None:
+                conn.close()
 
 
 @dataclasses.dataclass
@@ -131,12 +151,17 @@ class DrynxNode:
     def __init__(self, name: str, secret: int, public: tuple,
                  host: str = "127.0.0.1", port: int = 0,
                  data: Optional[np.ndarray] = None,
-                 db_path: Optional[str] = None):
+                 db_path: Optional[str] = None,
+                 policy: Optional[rp.RetryPolicy] = None):
         self.name = name
         self.secret = secret
         self.public = public
         self.data = data
-        self.server = NodeServer(host, port)
+        # all of this node's OUTBOUND calls (DP dispatch, proof delivery,
+        # VN polling) run under one RetryPolicy; tests inject short
+        # timeouts here instead of monkeypatching call sites
+        self.policy = policy or rp.DEFAULT_POLICY
+        self.server = NodeServer(host, port, node_name=name)
         self.roster: Optional[Roster] = None
         self.vn: Optional[VerifyingNode] = None
         self._db_path = db_path or f"/tmp/drynx_node_{name}.db"
@@ -155,6 +180,7 @@ class DrynxNode:
         s.register("ks_contrib", self._h_ks_contrib)
         s.register("proof_request", self._h_proof_request)
         s.register("vn_register", self._h_vn_register)
+        s.register("vn_adjust", self._h_vn_adjust)
         s.register("vn_bitmap", self._h_vn_bitmap)
         s.register("end_verification", self._h_end_verification)
         # skipchain retrieval RPCs (reference serves genesis/latest/specific
@@ -261,7 +287,7 @@ class DrynxNode:
                      "signature": _pack_bytes(req.signature.to_bytes())}
             for e in vns:
                 try:
-                    call_entry(e, frame)
+                    call_entry(e, frame, policy=self.policy)
                 except Exception as err:
                     # an unreachable/erroring VN simply never counts this
                     # proof; the end_verification counter gate reports the
@@ -331,7 +357,11 @@ class DrynxNode:
         op = msg["op"]
         qmin, qmax = msg["query_min"], msg["query_max"]
         group_by = msg.get("group_by") or None
-        rng = np.random.default_rng(abs(hash(self.name)) % 2**31)
+        # dummy-data seed derived from sha256(name): `hash()` is salted per
+        # process (PYTHONHASHSEED), which made multi-process runs draw
+        # different dummy data for the same node name — irreproducible
+        rng = np.random.default_rng(int.from_bytes(
+            hashlib.sha256(self.name.encode()).digest()[:4], "big"))
         if op == "log_reg":
             from ..models import logreg as lr
 
@@ -464,7 +494,7 @@ class DrynxNode:
         """Dispatch to a CN — loopback for self, TCP otherwise."""
         if entry.name == self.name:
             return self.server.handlers[msg["type"]](msg)
-        return call_entry(entry, msg)
+        return call_entry(entry, msg, policy=self.policy)
 
     # -- root CN: the whole survey (reference HandleSurveyQuery +
     # StartService phase order, service.go:263-747)
@@ -475,10 +505,18 @@ class DrynxNode:
         survey_id = msg["survey_id"]
         proofs = bool(msg.get("proofs"))
         ranges_v = [tuple(r) for r in msg.get("ranges") or []]
-        dps = self.roster.of_role("dp")
+        excluded = set(msg.get("dp_exclude") or ())
+        dps = [e for e in self.roster.of_role("dp")
+               if e.name not in excluded]
         cns = self.roster.of_role("cn")
+        # quorum-degraded execution: min_dp_quorum DPs must contribute for
+        # the survey to complete; 0 (the default) = all of them, the strict
+        # pre-resilience semantics
+        min_q = int(msg.get("min_dp_quorum") or 0)
+        need = min_q if min_q > 0 else len(dps)
         log.lvl1(f"{self.name}: survey {survey_id} op={op} "
-                 f"dps={len(dps)} cns={len(cns)} proofs={int(proofs)}")
+                 f"dps={len(dps)} cns={len(cns)} proofs={int(proofs)} "
+                 f"quorum={need}")
 
         # range-signature setup: every CN publishes its BB digit signatures
         # for each distinct base u in the query's ranges
@@ -497,18 +535,52 @@ class DrynxNode:
         # proofs at the VNs from their own processes
         range_offset = int(msg.get("range_offset", 0))
         cts = []
+        responders: list[str] = []
+        failed: list[str] = []
         for e in dps:
-            r = call_entry(e, {"type": "survey_dp", "op": op,
-                               "survey_id": survey_id,
-                               "query_min": msg["query_min"],
-                               "query_max": msg["query_max"],
-                               "lr_params": msg.get("lr_params"),
-                               "group_by": msg.get("group_by"),
-                               "range_offset": range_offset,
-                               "proofs": proofs, "ranges": ranges_v,
-                               "range_sigs": range_sigs_msg})
+            try:
+                r = call_entry(e, {"type": "survey_dp", "op": op,
+                                   "survey_id": survey_id,
+                                   "query_min": msg["query_min"],
+                                   "query_max": msg["query_max"],
+                                   "lr_params": msg.get("lr_params"),
+                                   "group_by": msg.get("group_by"),
+                                   "range_offset": range_offset,
+                                   "proofs": proofs, "ranges": ranges_v,
+                                   "range_sigs": range_sigs_msg},
+                               policy=self.policy)
+            except RemoteError:
+                raise   # the DP's handler ran and errored: a real bug,
+                        # not an availability fault — don't degrade past it
+            except (TransportError, OSError) as err:
+                log.warn(f"{self.name}: DP {e.name} unavailable for survey "
+                         f"{survey_id}: {err}")
+                failed.append(e.name)
+                continue
+            responders.append(e.name)
             cts.append(unpack_array(r["cts"]))
-        cts = jnp.asarray(np.stack(cts))                     # (n_dps, V, 2,3,16)
+        if len(responders) < need:
+            raise RuntimeError(
+                f"survey {survey_id}: only {len(responders)}/{len(dps)} DPs "
+                f"responded (quorum {need}); failed: {sorted(failed)}")
+        absent = sorted(excluded | set(failed))
+        if proofs and failed:
+            # the VNs were registered expecting a range proof per dialed
+            # DP; shrink their counters to the responder set or the
+            # expected-proof gate never drains (and the joint range flush
+            # never triggers)
+            for v in self.roster.of_role("vn"):
+                try:
+                    call_entry(v, {"type": "vn_adjust",
+                                   "survey_id": survey_id,
+                                   "expected_drop": len(failed),
+                                   "expected_range": len(responders),
+                                   "absent": sorted(failed)},
+                               policy=self.policy)
+                except (TransportError, OSError) as err:
+                    log.warn(f"{self.name}: vn_adjust undeliverable to "
+                             f"{v.name}: {err}")
+        cts = jnp.asarray(np.stack(cts))              # (n_responders, V, 2,3,16)
         agg = B.tree_reduce_add(cts, B.ct_add)
         if proofs:
             self._send_proof_async(
@@ -561,9 +633,10 @@ class DrynxNode:
 
         c2 = B.g1_add(agg[:, 1], c_sum)
         if range_offset:
-            # subtract the public aggregate shift (n_dps * u^l/2)·B so the
-            # decrypted values are the true signed statistics
-            total = range_offset * len(dps)
+            # subtract the public aggregate shift (n_responders * u^l/2)·B
+            # so the decrypted values are the true signed statistics — each
+            # RESPONDING DP added one offset; absent DPs added none
+            total = range_offset * len(responders)
             assert total < 2 ** 62, "offset too large for int64 scalar path"
             corr = B.fixed_base_mul(
                 eg.BASE_TABLE.table,
@@ -575,8 +648,9 @@ class DrynxNode:
         with self._state_lock:
             drained = self._proof_threads.pop(survey_id, [])
         for t in drained:
-            t.join(timeout=300)
-        return {"switched": pack_array(np.asarray(switched))}
+            t.join(timeout=rp.PROOF_DRAIN_S)
+        return {"switched": pack_array(np.asarray(switched)),
+                "responders": responders, "absent": absent}
 
     # -- VN handlers
     def _h_vn_register(self, msg: dict) -> dict:
@@ -600,6 +674,21 @@ class DrynxNode:
             }
         return {"ok": True}
 
+    def _h_vn_adjust(self, msg: dict) -> dict:
+        """Root CN tells this VN that DPs went absent mid-survey: shrink
+        the expected-proof counter (and the joint-range flush threshold)
+        to the responder set. Idempotent per absentee set — the adjustment
+        is expressed as absolute expected_range, not a delta on retry."""
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN")
+        self.vn.adjust_expected(
+            msg["survey_id"], int(msg.get("expected_drop", 0)),
+            expected_range=int(msg["expected_range"])
+            if msg.get("expected_range") is not None else None)
+        log.lvl2(f"VN {self.name}: survey {msg['survey_id']} adjusted for "
+                 f"absent DPs {msg.get('absent')}")
+        return {"ok": True}
+
     def _h_proof_request(self, msg: dict) -> dict:
         req = rq.ProofRequest(
             proof_type=msg["proof_type"], survey_id=msg["survey_id"],
@@ -619,7 +708,8 @@ class DrynxNode:
             raise RuntimeError(f"unknown survey {sid!r} at VN {self.name}")
         if msg.get("wait"):
             # block until this VN's expected-proof counter drains
-            if not state.done.wait(float(msg.get("timeout", 300.0))):
+            if not state.done.wait(float(msg.get("timeout",
+                                                 rp.VERIFY_WAIT_S))):
                 raise TimeoutError(
                     f"VN {self.name}: {len(state.bitmap)}/{state.expected} "
                     f"proofs received for {sid!r}")
@@ -630,46 +720,99 @@ class DrynxNode:
         """Root VN: counter-gated bitmap merge + audit-block commit.
 
         Round-1 weakness fixed: a survey with missing proofs can no longer
-        commit a clean-looking block — every VN must have received its full
-        expected count (reference: the bitmap-aggregation goroutine only
-        fires after the proof counter reaches zero,
-        proof_collection_protocol.go:362-398)."""
+        commit a clean-looking block — a reporting VN must have received
+        its full expected count (reference: the bitmap-aggregation
+        goroutine only fires after the proof counter reaches zero,
+        proof_collection_protocol.go:362-398).
+
+        VN quorum: ``vn_quorum`` in (0, 1] is the fraction of VNs that
+        must report a COMPLETE bitmap before the block commits (default
+        1.0 = every VN, the strict behavior). All VNs — including this
+        node's own counter wait — are polled CONCURRENTLY, so the commit
+        fires as soon as the quorum is met instead of serializing a full
+        timeout behind each straggler; the reply records which VNs made
+        the block (vn_reported) and which straggled (vn_absent)."""
         if self.vn is None:
             raise RuntimeError(f"node {self.name} is not a VN")
         survey_id = msg["survey_id"]
-        timeout = float(msg.get("timeout", 300.0))
+        timeout = float(msg.get("timeout", rp.VERIFY_WAIT_S))
+        quorum = float(msg.get("vn_quorum") or 1.0)
         vns = self.roster.of_role("vn")
         state = self.vn.surveys.get(survey_id)
         if state is None:
             raise RuntimeError(f"unknown survey {survey_id!r}")
-        if not state.done.wait(timeout):
+        # epsilon guards float fractions: 2/3 * 3 == 2.0000000000000004,
+        # which a bare ceil would round to "all 3 VNs"
+        need = max(1, math.ceil(quorum * len(vns) - 1e-9))
+
+        lock = threading.Lock()
+        reports: dict[str, dict] = {}
+        failures: dict[str, str] = {}
+        settled = threading.Event()
+
+        def note(name: str, bitmap=None, err=None):
+            with lock:
+                if err is None:
+                    reports[name] = bitmap
+                else:
+                    failures[name] = err
+                if (len(reports) >= need
+                        or len(reports) + len(failures) >= len(vns)):
+                    settled.set()
+
+        def poll(e):
+            try:
+                if e.name == self.name:
+                    if not state.done.wait(timeout):
+                        raise TimeoutError(
+                            f"VN {self.name}: {len(state.bitmap)}/"
+                            f"{state.expected} proofs received for "
+                            f"{survey_id!r}")
+                    bm, expected = (self.vn.bitmap_for(survey_id),
+                                    state.expected)
+                else:
+                    # socket timeout must outlive the peer's blocking wait
+                    r = call_entry(e, {"type": "vn_bitmap",
+                                       "survey_id": survey_id,
+                                       "wait": True, "timeout": timeout},
+                                   timeout=timeout + rp.STRAGGLER_GRACE_S,
+                                   policy=self.policy)
+                    bm, expected = r["bitmap"], r["expected"]
+                if len(bm) < expected:
+                    raise RuntimeError(
+                        f"VN {e.name} reports {len(bm)}/{expected} proofs "
+                        f"for {survey_id!r}; refusing to commit it")
+                note(e.name, bitmap=bm)
+            except Exception as err:
+                note(e.name, err=repr(err))
+
+        threads = [threading.Thread(target=poll, args=(e,), daemon=True)
+                   for e in vns]
+        for t in threads:
+            t.start()
+        settled.wait(timeout + 2 * rp.STRAGGLER_GRACE_S)
+        with lock:
+            snap = dict(reports)
+            fails = dict(failures)
+        if len(snap) < need:
             raise TimeoutError(
-                f"root VN {self.name}: {len(state.bitmap)}/{state.expected} "
-                f"proofs received for {survey_id!r}")
+                f"root VN {self.name}: {len(snap)}/{len(vns)} VNs report "
+                f"complete bitmaps for {survey_id!r} (quorum {need}); "
+                f"failures: {fails}")
+        reported = [e.name for e in vns if e.name in snap]
+        absent = [e.name for e in vns if e.name not in snap]
         merged = {}
-        for e in vns:
-            if e.name == self.name:
-                bm, expected = self.vn.bitmap_for(survey_id), state.expected
-            else:
-                # socket timeout must outlive the remote VN's blocking wait
-                r = call_entry(e, {"type": "vn_bitmap",
-                                   "survey_id": survey_id,
-                                   "wait": True, "timeout": timeout},
-                               timeout=timeout + 60.0)
-                bm, expected = r["bitmap"], r["expected"]
-            if len(bm) < expected:
-                raise RuntimeError(
-                    f"VN {e.name} reports {len(bm)}/{expected} proofs for "
-                    f"{survey_id!r}; refusing to commit an audit block")
-            for k, v in bm.items():
-                merged[f"{e.name}:{k}"] = v
+        for name in reported:
+            for k, v in snap[name].items():
+                merged[f"{name}:{k}"] = v
 
         self.vn.local_bitmaps[survey_id] = merged
         block = self.vn.chain.append(
             DataBlock(survey_id=survey_id, sample_time=time.time(),
                       bitmap=merged))
         return {"block_index": block.index, "block_hash": block.hash(),
-                "bitmap": merged}
+                "bitmap": merged, "vn_reported": reported,
+                "vn_absent": absent}
 
 
     # -- VN skipchain retrieval handlers (reference
@@ -712,18 +855,54 @@ class DrynxNode:
 class RemoteClient:
     """Querier for a multi-process deployment."""
 
-    def __init__(self, roster: Roster, rng: Optional[np.random.Generator] = None):
+    def __init__(self, roster: Roster,
+                 rng: Optional[np.random.Generator] = None,
+                 policy: Optional[rp.RetryPolicy] = None):
         self.roster = roster
         rng = rng or np.random.default_rng()
         self.secret, self.public = eg.keygen(rng)
+        self.policy = policy or rp.DEFAULT_POLICY
+        # Populated by run_survey when proofs/quorum bookkeeping runs.
+        self.last_responders: list[str] = []
+        self.last_absent: list[str] = []
 
-    def broadcast_roster(self):
+    def broadcast_roster(self) -> dict:
+        """Push the roster to every entry. Unreachable nodes are recorded
+        as False instead of aborting the whole broadcast — a dead node
+        picks the roster up via set_roster when it rejoins, and the
+        probe/quorum survey path tolerates its absence meanwhile."""
+        ok = {}
         for e in self.roster.entries:
-            c = Conn(e.host, e.port)
             try:
-                c.call({"type": "set_roster", "roster": self.roster.to_dict()})
-            finally:
-                c.close()
+                c = Conn(e.host, e.port, peer=e.name)
+                try:
+                    c.call({"type": "set_roster",
+                            "roster": self.roster.to_dict()})
+                    ok[e.name] = True
+                finally:
+                    c.close()
+            except (TransportError, OSError) as err:
+                log.warn(f"roster undeliverable to {e.name}: {err!r}")
+                ok[e.name] = False
+        return ok
+
+    def ping(self, entry: RosterEntry) -> bool:
+        """Liveness probe: one quick round-trip on a fresh connection. The
+        handler answers straight from the accept loop (no device work), so
+        an unanswered ping within PING_TIMEOUT_S means the node is down or
+        wedged — either way, unfit for survey dispatch."""
+        pol = dataclasses.replace(self.policy,
+                                  call_timeout_s=rp.PING_TIMEOUT_S,
+                                  connect_retries=0)
+        try:
+            r = call_entry(entry, {"type": "ping"}, policy=pol)
+            return bool(r.get("ok"))
+        except (TransportError, OSError):
+            return False
+
+    def probe_liveness(self) -> dict[str, bool]:
+        """Ping every roster entry; map node name -> alive."""
+        return {e.name: self.ping(e) for e in self.roster.entries}
 
     def expected_proofs(self, n_dps: int, n_cns: int, obfuscation: bool,
                         diffp: bool) -> int:
@@ -746,7 +925,10 @@ class RemoteClient:
                    proofs: bool = False, ranges=None,
                    obfuscation: bool = False, diffp: Optional[dict] = None,
                    lr_params=None, group_by=None,
-                   thresholds: float = 1.0, timeout: float = 300.0):
+                   thresholds: float = 1.0,
+                   timeout: float = rp.VERIFY_WAIT_S,
+                   min_dp_quorum: int = 0, vn_quorum: float = 1.0,
+                   probe: bool = False):
         """Full remote survey. With proofs on: collect range-sig publics from
         the CNs, register the survey (+ verify context) at every VN, run the
         query, then block on the root VN's counter-gated audit block
@@ -763,6 +945,27 @@ class RemoteClient:
         dps = self.roster.of_role("dp")
         vns = self.roster.of_role("vn")
         root = cns[0]
+        root_vn = vns[0] if vns else None
+
+        dp_exclude: list[str] = []
+        if probe:
+            # Exclude dead roster entries before dispatch instead of paying
+            # a connect-timeout per dead node inside the survey itself.
+            alive = self.probe_liveness()
+            dp_exclude = [e.name for e in dps if not alive.get(e.name)]
+            dps = [e for e in dps if alive.get(e.name)]
+            live_cns = [e for e in cns if alive.get(e.name)]
+            if not live_cns:
+                raise ConnectError("no CN answered the liveness probe")
+            root = live_cns[0]
+            if vns:
+                live_vns = [e for e in vns if alive.get(e.name)]
+                if not live_vns:
+                    raise ConnectError("no VN answered the liveness probe")
+                # register/collect only at live VNs; dead ones still count
+                # against the end_verification quorum (it walks the roster)
+                vns = live_vns
+                root_vn = live_vns[0]
 
         if op == "log_reg" and lr_params is None:
             raise ValueError("log_reg survey requires lr_params")
@@ -831,8 +1034,12 @@ class RemoteClient:
                               "group_by": [list(v) for v in group_by]
                               if group_by else None,
                               "range_offset": range_offset,
+                              "min_dp_quorum": int(min_dp_quorum),
+                              "dp_exclude": dp_exclude,
                               "client_pub": list(self.public)},
-                       timeout=max(timeout, 900.0))
+                       timeout=max(timeout, rp.CALL_TIMEOUT_S))
+        self.last_responders = list(r.get("responders") or [])
+        self.last_absent = list(r.get("absent") or [])
         switched = jnp.asarray(unpack_array(r["switched"]))
         dl = dlog or eg.DecryptionTable(limit=10000)
         xq = jnp.asarray(eg.secret_to_limbs(self.secret))
@@ -855,12 +1062,15 @@ class RemoteClient:
         if not proofs:
             return result
 
-        # the handler may block ~timeout on its own counter AND ~timeout per
-        # straggling VN; budget the socket for both phases
-        block = call_entry(vns[0], {"type": "end_verification",
-                                    "survey_id": survey_id,
-                                    "timeout": timeout},
-                           timeout=2 * timeout + 120.0)
+        # the handler may block ~timeout on its own counter plus the
+        # straggler grace on concurrent VN polls; budget the socket so the
+        # transport timeout outlives the application wait it wraps
+        block = call_entry(root_vn, {"type": "end_verification",
+                                     "survey_id": survey_id,
+                                     "timeout": timeout,
+                                     "vn_quorum": float(vn_quorum)},
+                           timeout=2 * timeout + 3 * rp.STRAGGLER_GRACE_S,
+                           policy=self.policy)
         return result, block
 
     # -- remote skipchain audit (reference api_skipchain.go:48-106:

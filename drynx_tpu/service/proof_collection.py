@@ -17,6 +17,7 @@ the verification math itself is the batched TPU kernels in drynx_tpu.proofs.
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 import time
 from typing import Callable, Optional
@@ -24,6 +25,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..proofs import requests as rq
+from ..resilience import policy as rp
 from ..utils import log
 from .skipchain import DataBlock, SkipChain, bitmap_verifier
 from .store import ProofDB
@@ -235,6 +237,14 @@ class VerifyingNode:
                 pending = dict(st.pending_range)
         if pending is None:
             return rq.BM_BADSIG if bad_sig else rq.BM_RECVD
+        self._flush_range(st, req.survey_id, pending, joint)
+        return st.bitmap[req.storage_key()]
+
+    def _flush_range(self, st: SurveyProofState, survey_id: str,
+                     pending: dict, joint) -> None:
+        """Joint-verify a snapshot of buffered range payloads and record
+        their codes. The caller must have set st.range_flushed under the
+        lock before snapshotting (exactly one flush per survey)."""
         t0 = time.perf_counter()
         keys = sorted(pending)
         to_verify = [k for k in keys if pending[k][1]]
@@ -245,7 +255,7 @@ class VerifyingNode:
             # co-located VN's verdict for the process lifetime
             with _VERIFY_DEVICE_LOCK:
                 return joint([pending[k][0].data for k in to_verify],
-                             req.survey_id)
+                             survey_id)
 
         results: list = []
         if to_verify:
@@ -256,7 +266,7 @@ class VerifyingNode:
                 h.update(hashlib.sha256(pending[k][0].data).digest())
             try:
                 results = self.verify_cache.get_or_compute(
-                    ("range_joint", req.survey_id, h.digest()), compute)
+                    ("range_joint", survey_id, h.digest()), compute)
             except Exception:
                 # malformed payloads are FAILED verifications for THIS
                 # flush only (mirrors rq.verify_proof_request containment)
@@ -282,7 +292,34 @@ class VerifyingNode:
                   f"{len(to_verify)}/{len(keys)} payloads: "
                   f"{time.perf_counter() - t0:.3f}s", file=sys.stderr,
                   flush=True)
-        return st.bitmap[req.storage_key()]
+
+    def adjust_expected(self, survey_id: str, drop: int,
+                        expected_range: Optional[int] = None) -> None:
+        """Quorum-degraded survey: the root CN reports that ``drop`` DPs
+        went absent, so this VN will never receive their proofs. Shrinks
+        the expected-proof counter and (when given) the joint-range flush
+        threshold to the responder set. If buffered payloads already meet
+        the lowered threshold the joint flush fires here, and if the
+        bitmap already covers the lowered counter the done event fires —
+        otherwise an absent DP would stall end_verification forever."""
+        st = self.surveys.get(survey_id)
+        if st is None:
+            raise KeyError(f"unknown survey {survey_id!r}")
+        joint = self.verify_fns.get("range_joint")
+        pending = None
+        with self._lock:
+            st.expected = max(0, st.expected - int(drop))
+            if expected_range is not None:
+                st.expected_range = int(expected_range)
+            if (not st.range_flushed and joint is not None
+                    and 0 < st.expected_range <= len(st.pending_range)):
+                st.range_flushed = True
+                pending = dict(st.pending_range)
+        if pending is not None:
+            self._flush_range(st, survey_id, pending, joint)
+        with self._lock:
+            if st.expected - len(st.bitmap) <= 0:
+                st.done.set()
 
     def bitmap_for(self, survey_id: str) -> dict[str, int]:
         st = self.surveys[survey_id]
@@ -319,16 +356,37 @@ class VNGroup:
         """Star fan-out: every VN receives and verifies the proof."""
         return [vn.receive_proof(req) for vn in self.vns]
 
-    def end_verification(self, survey_id: str, timeout: float = 60.0):
-        """Blocks until all proofs arrived at every VN, then the root VN
-        funnels bitmaps together and commits one audit block (reference
-        HandleEndVerification + the bitmap-aggregation goroutine)."""
-        for vn in self.vns:
-            if not vn.surveys[survey_id].done.wait(timeout):
+    def end_verification(self, survey_id: str,
+                         timeout: float = rp.VN_GROUP_WAIT_S,
+                         quorum: float = 1.0):
+        """Blocks until every VN's proof counter drained — or, with
+        ``quorum`` < 1.0, until that fraction of VNs is done — then the
+        root VN funnels the reporting VNs' bitmaps together and commits
+        one audit block (reference HandleEndVerification + the
+        bitmap-aggregation goroutine). All VNs share ONE deadline instead
+        of a full timeout each, so a straggler costs at most ``timeout``."""
+        # epsilon guards float fractions: 2/3 * 3 == 2.0000000000000004,
+        # which a bare ceil would round up to "all 3 VNs"
+        need = max(1, math.ceil(quorum * len(self.vns) - 1e-9))
+        deadline = time.monotonic() + timeout
+        while True:
+            ready = [vn for vn in self.vns
+                     if vn.surveys[survey_id].done.is_set()]
+            if len(ready) >= len(self.vns):
+                break
+            if need < len(self.vns) and len(ready) >= need:
+                break  # quorum met; don't serialize behind stragglers
+            if time.monotonic() >= deadline:
+                if len(ready) >= need:
+                    break
+                straggler = next(vn for vn in self.vns
+                                 if not vn.surveys[survey_id].done.is_set())
                 raise TimeoutError(
-                    f"VN {vn.name}: proofs incomplete for {survey_id!r}")
+                    f"VN {straggler.name}: proofs incomplete for "
+                    f"{survey_id!r}")
+            time.sleep(rp.POLL_INTERVAL_S)
         merged: dict[str, int] = {}
-        for vn in self.vns:
+        for vn in ready:
             for k, v in vn.bitmap_for(survey_id).items():
                 merged[f"{vn.name}:{k}"] = v
         block_data = DataBlock(survey_id=survey_id, sample_time=time.time(),

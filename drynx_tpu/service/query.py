@@ -88,6 +88,12 @@ class SurveyQuery:
     obfuscation_proof_threshold: float = 0.0
     range_proof_threshold: float = 0.0
     key_switching_proof_threshold: float = 0.0
+    # Resilience knobs (drynx_tpu/resilience, ROBUSTNESS.md): 0 = require
+    # every DP (the reference's one-shot behavior); N > 0 lets the survey
+    # complete over any >= N responding DPs. vn_quorum is the fraction of
+    # VNs whose complete bitmaps commit the audit block (1.0 = all).
+    min_dp_quorum: int = 0
+    vn_quorum: float = 1.0
 
 
 def choose_operation(name: str, query_min: int = 0, query_max: int = 0,
@@ -168,6 +174,12 @@ def check_parameters(sq: SurveyQuery, diffp: bool) -> tuple[bool, str]:
     if (q.operation.query_min != q.dp_data_min
             or q.operation.query_max != q.dp_data_max):
         msg.append("min/max inconsistent between DP data gen and operation")
+
+    n_dps = sum(len(v) for v in sq.server_to_dp.values())
+    if not 0 <= sq.min_dp_quorum <= n_dps:
+        msg.append(f"min_dp_quorum {sq.min_dp_quorum} outside [0, {n_dps}]")
+    if not 0.0 < sq.vn_quorum <= 1.0:
+        msg.append(f"vn_quorum {sq.vn_quorum} outside (0, 1]")
 
     return (len(msg) == 0, "; ".join(msg))
 

@@ -42,6 +42,8 @@ from ..proofs import obfuscation as obf_proof
 from ..proofs import range_proof as rproof
 from ..proofs import requests as rq
 from ..proofs import shuffle as shuffle_proof
+from ..resilience import faults
+from ..resilience import policy as rp
 from ..utils import log
 from ..utils.timers import PhaseTimers
 from .proof_collection import VerifyCache, VerifyingNode, VNGroup
@@ -266,7 +268,8 @@ class LocalCluster:
                               ranges=None, diffp: Optional[DiffPParams] = None,
                               lr_params=None, thresholds: float = 1.0,
                               cutting_factor: int = 0,
-                              group_by=None) -> SurveyQuery:
+                              group_by=None, min_dp_quorum: int = 0,
+                              vn_quorum: float = 1.0) -> SurveyQuery:
         op = choose_operation(op_name, query_min, query_max, dims,
                               cutting_factor, lr_params)
         if group_by and op_name == "log_reg":
@@ -308,7 +311,8 @@ class LocalCluster:
             obfuscation_proof_threshold=(thresholds if proofs and obfuscation
                                          else 0.0),
             range_proof_threshold=thresholds if proofs else 0.0,
-            key_switching_proof_threshold=thresholds if proofs else 0.0)
+            key_switching_proof_threshold=thresholds if proofs else 0.0,
+            min_dp_quorum=min_dp_quorum, vn_quorum=vn_quorum)
         ok, msg = check_parameters(sq, q.diffp.enabled())
         if not ok:
             raise ValueError(f"invalid survey parameters: {msg}")
@@ -421,13 +425,35 @@ class LocalCluster:
         tm = survey.timers
         key = jax.random.PRNGKey(seed)
         proofs_on = q.proofs == 1 and self.vns is not None
+
+        # --- Quorum-degraded membership: an active FaultPlan's node kills
+        # are the in-process equivalent of a DP that never answers the TCP
+        # dispatch (service/node.py _h_survey_query). The survey proceeds
+        # over the responders iff they meet min_dp_quorum, and the VN
+        # expected-proof counters are sized to the responder set.
+        plan = faults.fault_plan()
+        dp_idents = list(self.dp_idents)
+        absent: list[str] = []
+        if plan is not None:
+            absent = [d.name for d in dp_idents if plan.killed(d.name)]
+            dp_idents = [d for d in dp_idents if d.name not in absent]
+        responders = [d.name for d in dp_idents]
+        need = (sq.min_dp_quorum if sq.min_dp_quorum > 0
+                else len(self.dp_idents))
+        if len(responders) < need:
+            raise RuntimeError(
+                f"survey {sq.survey_id}: only {len(responders)}/"
+                f"{len(self.dp_idents)} DPs responded (quorum {need}); "
+                f"absent: {sorted(absent)}")
         log.lvl1(f"survey {sq.survey_id}: op={op.name} "
-                 f"dps={len(self.dp_idents)} cns={len(self.cns)} "
+                 f"dps={len(responders)}/{len(self.dp_idents)} "
+                 f"cns={len(self.cns)} "
                  f"proofs={int(proofs_on)} groups={q.n_groups()}")
 
         if proofs_on:
             nbrs = query_to_proofs_nbrs(sq)
-            expected = sum(nbrs)
+            # absent DPs owe one range proof each; everything else is CN-side
+            expected = sum(nbrs) - len(absent)
             self.vns.register_survey(
                 sq.survey_id, expected,
                 {"range": sq.range_proof_threshold,
@@ -435,13 +461,13 @@ class LocalCluster:
                  "aggregation": sq.aggregation_proof_threshold,
                  "obfuscation": sq.obfuscation_proof_threshold,
                  "keyswitch": sq.key_switching_proof_threshold},
-                expected_range=nbrs[0])
+                expected_range=nbrs[0] - len(absent))
 
         # --- DP phase: encode + encrypt (+ range proofs) ----------------
         tm.start("DataCollectionProtocol")
         dp_stats = np.stack([
             self.dps[d.name].local_stats(op, self.rng, q.group_by)
-            for d in self.dp_idents])              # (n_dps, V) or (n_dps,G,Vg)
+            for d in dp_idents])                   # (n_dps, V) or (n_dps,G,Vg)
         if q.group_by:
             # group-major flatten: the aligned group axis makes element-wise
             # homomorphic addition the per-group aggregation (no same-group
@@ -502,7 +528,7 @@ class LocalCluster:
                                 sigs_by_u, self.coll_tbl.table)
                     return lists_box["v"]
 
-            for i, dp in enumerate(self.dp_idents):
+            for i, dp in enumerate(dp_idents):
                 self._async_proof(
                     survey, "range", dp,
                     lambda i=i: dp_lists()[i].to_bytes())
@@ -607,7 +633,7 @@ class LocalCluster:
         # U = r·B,  W = r·Q − x·K   (commuting; sum replaces the CN chain);
         # the fused program also subtracts the public aggregate shift
         # (n_dps * u^l/2)·B so decrypted values are true signed statistics
-        total = range_offset * len(self.dp_idents)
+        total = range_offset * len(dp_idents)  # one offset per RESPONDER
         assert total < 2 ** 62, "offset too large for int64 scalar path"
         switched, u_pts, w_pts = f_ks(
             agg, ks_rs, srv_x, jnp.asarray(total, dtype=jnp.int64))
@@ -665,15 +691,18 @@ class LocalCluster:
             # includes all pairing-kernel compiles (tens of minutes at
             # opt-level 0 on one core; seconds on TPU)
             for t in survey.proof_threads:
-                t.join(timeout=2400)
-            block = self.vns.end_verification(sq.survey_id, timeout=2400)
+                t.join(timeout=rp.COLD_COMPILE_WAIT_S)
+            block = self.vns.end_verification(
+                sq.survey_id, timeout=rp.COLD_COMPILE_WAIT_S,
+                quorum=sq.vn_quorum)
             log.lvl2(f"survey {sq.survey_id}: audit block "
                      f"#{block.index} committed, "
                      f"{len(block.data.bitmap)} bitmap entries")
         log.lvl1(f"survey {sq.survey_id}: done; phases: " + ", ".join(
             f"{k}={v:.3f}s" for k, v in tm.items()))
         return SurveyResult(result=result, decrypted=dec, block=block,
-                            timers=tm, survey_id=sq.survey_id)
+                            timers=tm, survey_id=sq.survey_id,
+                            responders=responders, absent=sorted(absent))
 
     # ------------------------------------------------------------------
     def _async_proof(self, survey: Survey, ptype: str, ident: NodeIdentity,
@@ -781,6 +810,9 @@ class SurveyResult:
     block: object
     timers: PhaseTimers
     survey_id: str
+    # quorum bookkeeping: which DPs actually contributed (ROBUSTNESS.md)
+    responders: list = dataclasses.field(default_factory=list)
+    absent: list = dataclasses.field(default_factory=list)
 
 
 def _pickle(obj) -> bytes:

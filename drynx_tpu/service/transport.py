@@ -7,6 +7,21 @@ collectives inside the device mesh, while the *control plane* (query
 distribution, DP responses from external institutions, proof envelopes) is
 host-side networking — this module. Binary tensors travel as base64 fields
 inside JSON frames; every frame is [u32 length][utf-8 JSON payload].
+
+Failure contract: every transport failure raises a subclass of
+:class:`TransportError`. The subclasses multiply-inherit the builtin
+exception a pre-resilience caller would have caught (``ConnectionError``,
+``TimeoutError``, ``RuntimeError``) so existing ``except`` clauses keep
+working while new code can catch one hierarchy. A :class:`Conn` whose
+frame exchange failed mid-flight is *broken*: the socket is in an
+undefined state (a partial frame may be on the wire), so it is closed and
+every later call raises immediately — recovery is a NEW connection,
+decided by the caller's RetryPolicy (drynx_tpu/resilience/policy.py).
+
+Fault injection: when a :class:`~drynx_tpu.resilience.faults.FaultPlan`
+is active (set_fault_plan), the client hooks (connect/request) and server
+hooks (node/reply) consult it — see faults.py for the hook taxonomy. With
+no plan active every hook is a no-op on the hot path.
 """
 from __future__ import annotations
 
@@ -20,6 +35,41 @@ import time
 from typing import Callable, Optional
 
 import numpy as np
+
+from ..resilience import faults
+from ..resilience import policy as rp
+
+
+# ---------------------------------------------------------------------------
+# Typed failure hierarchy
+# ---------------------------------------------------------------------------
+
+class TransportError(Exception):
+    """Base of every control-plane transport failure."""
+
+
+class ConnectError(TransportError, ConnectionError):
+    """TCP connect to a roster entry failed (refused / unreachable)."""
+
+
+class ConnectionClosed(TransportError, ConnectionError):
+    """The peer closed (or reset) the connection mid-exchange."""
+
+
+class CallTimeout(TransportError, TimeoutError):
+    """The socket timed out mid-frame; the connection is now broken."""
+
+
+class FrameTooLarge(TransportError):
+    """A frame header announced more bytes than the configured cap."""
+
+
+class CorruptFrame(TransportError):
+    """A frame's payload did not decode as UTF-8 JSON."""
+
+
+class RemoteError(TransportError, RuntimeError):
+    """The peer's handler raised; its error reply carries the repr."""
 
 
 class LinkModel:
@@ -69,6 +119,19 @@ def set_link_model(m: Optional[LinkModel]) -> None:
     _LINK = m
 
 
+# Frame-size cap: a corrupt or malicious 4-byte header must not drive an
+# unbounded allocation (the old recv_msg would try to buffer up to 4 GiB).
+# 64 MiB clears the largest legitimate payload by >100x (a 1024-value
+# survey's ciphertext frame is ~500 KiB); DRYNX_MAX_FRAME_BYTES overrides
+# for deployments shipping bigger tensors.
+MAX_FRAME_BYTES = int(os.environ.get("DRYNX_MAX_FRAME_BYTES", str(1 << 26)))
+
+
+def set_max_frame_bytes(n: int) -> None:
+    global MAX_FRAME_BYTES
+    MAX_FRAME_BYTES = int(n)
+
+
 def b64(data: bytes) -> str:
     return base64.b64encode(data).decode()
 
@@ -94,13 +157,27 @@ def send_msg(sock: socket.socket, obj: dict) -> None:
     sock.sendall(len(raw).to_bytes(4, "big") + raw)
 
 
-def recv_msg(sock: socket.socket) -> Optional[dict]:
+def recv_msg(sock: socket.socket,
+             max_bytes: Optional[int] = None) -> Optional[dict]:
+    """One frame, or None on clean EOF. Raises :class:`FrameTooLarge`
+    before allocating anything for an oversized header and
+    :class:`CorruptFrame` when the payload isn't UTF-8 JSON."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
     n = int.from_bytes(head, "big")
+    cap = MAX_FRAME_BYTES if max_bytes is None else int(max_bytes)
+    if n > cap:
+        raise FrameTooLarge(
+            f"frame header announces {n} bytes, cap is {cap} "
+            f"(set_max_frame_bytes / DRYNX_MAX_FRAME_BYTES to raise)")
     body = _recv_exact(sock, n)
-    return None if body is None else json.loads(body.decode())
+    if body is None:
+        return None
+    try:
+        return json.loads(body.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise CorruptFrame(f"undecodable {n}-byte frame: {e}") from e
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -113,6 +190,33 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+def _send_faulted_frame(sock: socket.socket, obj: dict,
+                        act: faults.FaultSpec) -> bool:
+    """Emit (or suppress) one frame according to a request/reply fault.
+    Returns False when the connection must be torn down afterwards."""
+    raw = json.dumps(obj).encode()
+    if act.kind == "drop":
+        return True                      # frame vanishes on the wire
+    if act.kind == "delay":
+        time.sleep(act.delay_s)
+        sock.sendall(len(raw).to_bytes(4, "big") + raw)
+        return True
+    if act.kind == "corrupt":
+        # same length, first byte 0xFF: never valid UTF-8 JSON
+        raw = b"\xff" + raw[1:]
+        sock.sendall(len(raw).to_bytes(4, "big") + raw)
+        return True
+    if act.kind == "close_mid_frame":
+        sock.sendall(len(raw).to_bytes(4, "big") + raw[:max(1, len(raw) // 2)])
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        sock.close()
+        return False
+    raise ValueError(f"unhandled fault kind {act.kind!r}")
+
+
 Handler = Callable[[dict], dict]
 
 
@@ -121,19 +225,40 @@ class NodeServer:
 
     The onet service-handler analogue: handlers are registered by message
     type (reference RegisterHandler via onet, service.go:149-170).
+    ``node_name`` identifies this node to the fault plan's node/reply
+    hooks (DrynxNode sets it; anonymous test servers stay exempt from
+    name-targeted faults unless the plan targets "*").
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 node_name: str = ""):
         self.handlers: dict[str, Handler] = {}
+        self.node_name = node_name
         outer = self
 
         class _H(socketserver.BaseRequestHandler):
             def handle(self):
                 while True:
-                    msg = recv_msg(self.request)
+                    plan = faults.fault_plan()
+                    name = outer.node_name
+                    if plan is not None and name and plan.killed(name):
+                        return           # dead node: close without a word
+                    try:
+                        msg = recv_msg(self.request)
+                    except TransportError:
+                        # oversized/corrupt framing is unrecoverable on a
+                        # stream transport: drop the connection, the peer
+                        # sees ConnectionClosed and decides via its policy
+                        return
                     if msg is None:
                         return
                     mtype = msg.get("type", "")
+                    if plan is not None and name:
+                        nf = plan.node_fault(name)
+                        if nf is not None and nf.kind == "kill":
+                            return
+                        if nf is not None and nf.kind == "pause":
+                            time.sleep(nf.delay_s)
                     fn = outer.handlers.get(mtype)
                     try:
                         if fn is None:
@@ -142,6 +267,12 @@ class NodeServer:
                         reply.setdefault("type", mtype + "_reply")
                     except Exception as e:  # fault is reported, not fatal
                         reply = {"type": "error", "error": repr(e)}
+                    act = (plan.pick("reply", name, mtype)
+                           if plan is not None and name else None)
+                    if act is not None:
+                        if not _send_faulted_frame(self.request, reply, act):
+                            return
+                        continue
                     send_msg(self.request, reply)
 
         class _Srv(socketserver.ThreadingTCPServer):
@@ -169,21 +300,91 @@ class NodeServer:
 
 
 class Conn:
-    """Client connection with request/response semantics (SendProtobuf)."""
+    """Client connection with request/response semantics (SendProtobuf).
 
-    def __init__(self, host: str, port: int, timeout: float = 900.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+    ``peer`` names the destination node for fault-plan matching and error
+    messages (call_entry passes the roster name; raw callers get
+    "host:port"). After any mid-exchange failure the connection is
+    ``broken``: closed, and every later call raises ConnectionClosed.
+    ``sent`` reports whether the *last* call wrote any request bytes —
+    the retry policy's idempotency gate reads it.
+    """
+
+    def __init__(self, host: str, port: int,
+                 timeout: float = rp.CALL_TIMEOUT_S, peer: str = ""):
+        self.peer = peer or f"{host}:{port}"
+        self.broken = False
+        self.sent = False
         self._lock = threading.Lock()
+        plan = faults.fault_plan()
+        if plan is not None:
+            if plan.killed(self.peer):
+                raise ConnectError(f"connect to {self.peer} refused "
+                                   f"(fault plan: node killed)")
+            act = plan.pick("connect", self.peer)
+            if act is not None:
+                if act.kind == "delay":
+                    time.sleep(act.delay_s)
+                elif act.kind == "refuse":
+                    raise ConnectError(
+                        f"connect to {self.peer} refused (fault plan)")
+        try:
+            self.sock = socket.create_connection((host, port),
+                                                 timeout=timeout)
+        except OSError as e:
+            raise ConnectError(f"connect to {self.peer} failed: {e}") from e
 
     def call(self, obj: dict) -> dict:
+        mtype = obj.get("type", "")
+        if self.broken:
+            raise ConnectionClosed(
+                f"connection to {self.peer} already broken")
         with self._lock:
-            send_msg(self.sock, obj)
-            reply = recv_msg(self.sock)
+            self.sent = False
+            try:
+                plan = faults.fault_plan()
+                act = (plan.pick("request", self.peer, mtype)
+                       if plan is not None else None)
+                if act is not None:
+                    self.sent = True
+                    if not _send_faulted_frame(self.sock, obj, act):
+                        self._mark_broken()
+                        raise ConnectionClosed(
+                            f"connection to {self.peer} lost after partial "
+                            f"write of {mtype!r} (fault plan)")
+                else:
+                    send_msg(self.sock, obj)
+                    self.sent = True
+                reply = recv_msg(self.sock)
+            except ConnectionClosed:
+                raise
+            except socket.timeout as e:
+                self._mark_broken()
+                raise CallTimeout(
+                    f"timeout mid-call to {self.peer} ({mtype!r}); "
+                    f"connection dropped") from e
+            except TransportError:
+                self._mark_broken()
+                raise
+            except OSError as e:
+                self._mark_broken()
+                raise ConnectionClosed(
+                    f"connection to {self.peer} failed mid-call "
+                    f"({mtype!r}): {e}") from e
         if reply is None:
-            raise ConnectionError("connection closed by peer")
+            self._mark_broken()
+            raise ConnectionClosed(
+                f"connection closed by peer {self.peer}")
         if reply.get("type") == "error":
-            raise RuntimeError(f"remote error: {reply.get('error')}")
+            raise RemoteError(f"remote error: {reply.get('error')}")
         return reply
+
+    def _mark_broken(self) -> None:
+        self.broken = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     def close(self) -> None:
         self.sock.close()
@@ -191,4 +392,6 @@ class Conn:
 
 __all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
            "recv_msg", "NodeServer", "Conn", "LinkModel", "link_model",
-           "set_link_model"]
+           "set_link_model", "set_max_frame_bytes", "MAX_FRAME_BYTES",
+           "TransportError", "ConnectError", "ConnectionClosed",
+           "CallTimeout", "FrameTooLarge", "CorruptFrame", "RemoteError"]

@@ -46,6 +46,13 @@ class SimulationConfig:
     # TIFS/networkTraffic.py). 0 = ideal network (off).
     delay_ms: float = 0.0
     bandwidth_mbps: float = 0.0
+    # chaos rows (drynx_tpu/resilience, ROBUSTNESS.md): kill the first
+    # chaos_kill_dps DPs under a FaultPlan seeded with chaos_seed, and let
+    # the survey complete over >= min_dp_quorum responders (0 = require
+    # all, the strict default).
+    chaos_seed: int = 0
+    chaos_kill_dps: int = 0
+    min_dp_quorum: int = 0
 
     # reference runfile spellings (drynx_simul.go:28-80) -> our field names
     _ALIASES = {
@@ -55,6 +62,8 @@ class SimulationConfig:
         "diffpsize": "diffp_size", "diffpscale": "diffp_scale",
         "delay": "delay_ms", "bandwidth": "bandwidth_mbps",
         "delayms": "delay_ms", "bandwidthmbps": "bandwidth_mbps",
+        "chaosseed": "chaos_seed", "chaoskilldps": "chaos_kill_dps",
+        "mindpquorum": "min_dp_quorum",
     }
 
     # onet runfile boilerplate the reference tolerates (drynx_simul.go decodes
@@ -86,6 +95,25 @@ class SimulationConfig:
 
 def run_simulation(cfg: SimulationConfig) -> dict:
     """Run one configuration end to end; returns result + phase timings."""
+    from ..resilience import FaultPlan, fault_plan, set_fault_plan
+    from ..service.api import DrynxClient
+    from ..service.query import DiffPParams
+    from ..service.service import LocalCluster
+    from ..service.transport import LinkModel
+
+    prev_plan = fault_plan()
+    if cfg.chaos_kill_dps > 0:
+        plan = FaultPlan(seed=cfg.chaos_seed)
+        for i in range(min(cfg.chaos_kill_dps, cfg.nbr_dps)):
+            plan.kill(f"dp{i}")
+        set_fault_plan(plan)
+    try:
+        return _run_simulation(cfg)
+    finally:
+        set_fault_plan(prev_plan)
+
+
+def _run_simulation(cfg: SimulationConfig) -> dict:
     from ..service.api import DrynxClient
     from ..service.query import DiffPParams
     from ..service.service import LocalCluster
@@ -115,6 +143,7 @@ def run_simulation(cfg: SimulationConfig) -> dict:
         sq = client.generate_survey_query(
             cfg.operation, query_min=cfg.query_min, query_max=cfg.query_max,
             proofs=cfg.proofs, diffp=diffp,
+            min_dp_quorum=cfg.min_dp_quorum,
             ranges=[(cfg.ranges_u, cfg.ranges_l)] *
             sq_out_size(cfg) if cfg.proofs else None)
         t0 = time.perf_counter()
@@ -135,6 +164,7 @@ def run_simulation(cfg: SimulationConfig) -> dict:
             bitmap[int(code)] = bitmap.get(int(code), 0) + 1
     return {"config": dataclasses.asdict(cfg), "result": res.result,
             "timings": timings, "bitmap_codes": bitmap,
+            "responders": list(res.responders), "absent": list(res.absent),
             "block_hash": res.block.hash() if res.block else None}
 
 

@@ -17,4 +17,8 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     tests/test_service_vn.py \
     tests/test_datasets_timedata.py
 
+echo "== chaos quick tier (seeded fault injection, -m 'chaos and not slow') =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
+    -m 'chaos and not slow' tests/test_resilience.py
+
 echo "check.sh: all green"

@@ -237,6 +237,57 @@ def test_secret_logging_ignores_public_material():
     assert run(src, rule="secret-logging") == []
 
 
+# -- hardcoded-timeout ------------------------------------------------------
+
+SERVICE = "drynx_tpu/service/synthetic.py"
+RESILIENCE = "drynx_tpu/resilience/synthetic.py"
+
+
+def test_hardcoded_timeout_fires_on_literals():
+    src = """
+        import time
+
+        def call(entry, msg, retries=2, timeout=300.0):
+            time.sleep(0.2)
+            t = msg.get("timeout", 600.0)
+            other(timeout=900.0)
+            thread.join(5.0)
+    """
+    found = run(src, relpath=SERVICE, rule="hardcoded-timeout")
+    assert len(found) == 6
+    texts = " ".join(f.message for f in found)
+    assert "retries=2" in texts and "timeout=300.0" in texts
+    assert ".sleep(0.2)" in texts and ".get('timeout', 600.0)" in texts
+
+
+def test_hardcoded_timeout_allows_named_constants_and_zero():
+    src = """
+        from drynx_tpu.resilience import policy as rp
+
+        def call(entry, msg, timeout=rp.CALL_TIMEOUT_S, retries=0):
+            t = msg.get("timeout", rp.VERIFY_WAIT_S)
+            other(timeout=t, retries=rp.CONNECT_RETRIES)
+            cond.wait(t + rp.STRAGGLER_GRACE_S)
+    """
+    assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
+
+
+def test_hardcoded_timeout_exempts_the_resilience_package():
+    src = """
+        CALL_TIMEOUT_S = 900.0
+
+        def probe(timeout=5.0):
+            sock.wait(0.2)
+    """
+    assert run(src, relpath=RESILIENCE, rule="hardcoded-timeout") == []
+
+
+def test_hardcoded_timeout_outside_drynx_pkg_is_ignored():
+    src = "def f(timeout=30.0):\n    pass\n"
+    assert run(src, relpath="scripts/helper.py",
+               rule="hardcoded-timeout") == []
+
+
 # -- suppression + baseline mechanics ---------------------------------------
 
 def test_noqa_suppresses_named_rule_only():

@@ -1,0 +1,496 @@
+"""Seeded chaos scenarios for the TCP control plane (drynx_tpu/resilience).
+
+Every scenario drives REAL sockets through a deterministic FaultPlan:
+dead DPs at dispatch, a DP dying mid-contribution, a straggling VN, and
+corrupt/oversized frames. Degraded surveys must still return the correct
+aggregate over the responder set, and the same plan seed must produce the
+same outcome twice (the acceptance bar in ISSUE/ROBUSTNESS.md).
+"""
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from drynx_tpu.crypto import elgamal as eg
+from drynx_tpu.resilience import (FaultPlan, FaultSpec, RetryPolicy,
+                                  is_idempotent, set_fault_plan)
+from drynx_tpu.resilience import policy as rp
+from drynx_tpu.service.node import (DrynxNode, RemoteClient, Roster,
+                                    RosterEntry, call_entry)
+from drynx_tpu.service.transport import (CallTimeout, Conn, ConnectError,
+                                         ConnectionClosed, CorruptFrame,
+                                         FrameTooLarge, NodeServer,
+                                         RemoteError, TransportError,
+                                         pack_array, recv_msg)
+
+pytestmark = pytest.mark.chaos
+
+# Chaos tests inject instant faults (refuse / close_mid_frame), so retries
+# only cost these short backoffs; the call timeout stays generous because
+# a cold process still compiles crypto kernels mid-survey.
+FAST = RetryPolicy(connect_retries=1, backoff_s=0.02, backoff_cap_s=0.05,
+                   jitter=0.0, call_timeout_s=rp.CALL_TIMEOUT_S, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    set_fault_plan(None)
+    yield
+    set_fault_plan(None)
+
+
+def _boot(tmp_path, roles, rng, policy=FAST):
+    """Start DrynxNode servers named <role><i> (per-role counters) and
+    return (nodes, entries, dp_datas, secrets)."""
+    nodes, entries, datas, secrets_of = [], [], {}, {}
+    counts = {}
+    for role in roles:
+        i = counts.get(role, 0)
+        counts[role] = i + 1
+        name = f"{role}{i}"
+        x, pub = eg.keygen(rng)
+        secrets_of[name] = x
+        data = None
+        if role == "dp":
+            data = rng.integers(0, 10, size=(8,)).astype(np.int64)
+            datas[name] = data
+        n = DrynxNode(name, x, pub, data=data,
+                      db_path=str(tmp_path / f"{name}.db"), policy=policy)
+        n.start()
+        entries.append(RosterEntry(name=name, role=role, host=n.address[0],
+                                   port=n.address[1], public=pub))
+        nodes.append(n)
+    return nodes, entries, datas, secrets_of
+
+
+def _stop(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def _frame(payload: bytes) -> bytes:
+    return len(payload).to_bytes(4, "big") + payload
+
+
+# -- RetryPolicy / FaultPlan units ------------------------------------------
+
+def test_retry_policy_backoff_deterministic_and_capped():
+    pol = RetryPolicy(backoff_s=0.2, backoff_cap_s=1.0, jitter=0.0)
+    assert [pol.backoff(a) for a in range(4)] == [0.2, 0.4, 0.8, 1.0]
+    j1 = RetryPolicy(backoff_s=0.2, backoff_cap_s=1.0, jitter=0.25, seed=3)
+    j2 = RetryPolicy(backoff_s=0.2, backoff_cap_s=1.0, jitter=0.25, seed=3)
+    draws = [j1.backoff(a) for a in range(4)]
+    assert draws == [j2.backoff(a) for a in range(4)]  # seeded => replayable
+    for a, d in enumerate(draws):
+        base = min(0.2 * 2.0 ** a, 1.0)
+        assert base * 0.75 <= d <= base * 1.25
+
+
+def test_retry_policy_idempotency_gate():
+    assert is_idempotent("ping") and is_idempotent("vn_bitmap")
+    assert not is_idempotent("survey_dp") and not is_idempotent("made_up")
+    pol = RetryPolicy(connect_retries=2)
+    # connect-class failures (nothing sent) always retry
+    assert pol.attempts_for("survey_dp", sent=False) == 3
+    # idempotent calls retry even after a partial exchange
+    assert pol.attempts_for("ping", sent=True) == 3
+    # contributions never re-send once bytes hit the wire
+    assert pol.attempts_for("survey_dp", sent=True) == 1
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError):
+        FaultSpec(where="bogus", kind="drop")
+    with pytest.raises(ValueError):
+        FaultSpec(where="request", kind="bogus")
+    with pytest.raises(ValueError):
+        FaultSpec(where="request", kind="kill")  # node-level kind
+
+
+def test_fault_plan_same_seed_same_draws():
+    def draws(seed):
+        plan = FaultPlan(seed=seed)
+        plan.add(FaultSpec(where="request", kind="drop", prob=0.5))
+        return [plan.pick("request", "dp0", "survey_dp") is not None
+                for _ in range(32)]
+
+    seq = draws(seed=9)
+    assert seq == draws(seed=9)
+    assert True in seq and False in seq  # prob actually gates
+
+    def verdicts(seed):
+        plan = FaultPlan(seed=seed)
+        plan.add(FaultSpec(where="node", kind="kill", target="dp*",
+                           prob=0.5))
+        v = {f"dp{i}": plan.killed(f"dp{i}") for i in range(8)}
+        # memoized: a node never flaps between dead and alive
+        assert all(plan.killed(n) == dead for n, dead in v.items())
+        return v
+
+    assert verdicts(seed=4) == verdicts(seed=4)
+
+
+def test_fault_plan_count_cap():
+    plan = FaultPlan(seed=0)
+    spec = plan.add(FaultSpec(where="connect", kind="refuse", target="dp0",
+                              count=2))
+    hits = [plan.pick("connect", "dp0") is not None for _ in range(4)]
+    assert hits == [True, True, False, False] and spec.fired == 2
+
+
+# -- framing hardening (satellite 1) ----------------------------------------
+
+def test_recv_msg_bounds_frame_length():
+    a, b = socket.socketpair()
+    try:
+        a.sendall((2048).to_bytes(4, "big"))  # header only; no 2 KiB body
+        with pytest.raises(FrameTooLarge, match="2048"):
+            recv_msg(b, max_bytes=1024)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_msg_rejects_corrupt_frame():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_frame(b"\xff{not json"))
+        with pytest.raises(CorruptFrame):
+            recv_msg(b)
+        a.sendall(_frame(b'{"type": "ok"}'))
+        assert recv_msg(b) == {"type": "ok"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_call_timeout_marks_connection_broken():
+    srv = NodeServer()
+    srv.register("nap", lambda m: time.sleep(m["s"]) or {"ok": True})
+    srv.start()
+    c = Conn(srv.host, srv.port, timeout=0.3)
+    try:
+        with pytest.raises(CallTimeout):
+            c.call({"type": "nap", "s": 5.0})
+        # a timed-out stream is poisoned: later calls must refuse upfront
+        with pytest.raises(ConnectionClosed):
+            c.call({"type": "nap", "s": 0.0})
+        assert isinstance(CallTimeout("x"), TimeoutError)  # typed hierarchy
+        assert issubclass(CallTimeout, TransportError)
+    finally:
+        c.close()
+        srv.stop()
+
+
+# -- retry semantics over real sockets --------------------------------------
+
+def test_call_entry_retries_refused_connect():
+    srv = NodeServer()
+    calls = []
+    srv.register("ping", lambda m: calls.append(1) or {"ok": True})
+    srv.start()
+    entry = RosterEntry(name="p0", role="dp", host=srv.host, port=srv.port,
+                        public=(0, 0))
+    plan = FaultPlan(seed=0)
+    plan.add(FaultSpec(where="connect", kind="refuse", target="p0", count=2))
+    set_fault_plan(plan)
+    try:
+        with pytest.raises(ConnectError):      # no retries -> surfaces
+            call_entry(entry, {"type": "ping"}, retries=0, policy=FAST)
+        # one fault charge left; a single retry rides past it
+        assert call_entry(entry, {"type": "ping"}, retries=1,
+                          policy=FAST)["ok"]
+        assert calls == [1]
+    finally:
+        srv.stop()
+
+
+def test_idempotent_call_retried_after_dropped_request():
+    srv = NodeServer()
+    calls = []
+    srv.register("ping", lambda m: calls.append(1) or {"ok": True})
+    srv.start()
+    entry = RosterEntry(name="p1", role="dp", host=srv.host, port=srv.port,
+                        public=(0, 0))
+    plan = FaultPlan(seed=0)
+    plan.add(FaultSpec(where="request", kind="drop", target="p1",
+                       mtype="ping", count=1))
+    set_fault_plan(plan)
+    pol = RetryPolicy(connect_retries=2, backoff_s=0.01, backoff_cap_s=0.02,
+                      jitter=0.0, call_timeout_s=0.4, seed=0)
+    try:
+        # the dropped frame costs one call-timeout, then the idempotent
+        # retry goes through on a fresh connection
+        assert call_entry(entry, {"type": "ping"}, policy=pol)["ok"]
+        assert calls == [1]
+    finally:
+        srv.stop()
+
+
+def test_contribution_never_resent_after_partial_write():
+    srv = NodeServer()
+    calls = []
+    srv.register("survey_dp", lambda m: calls.append(1) or {"ok": True})
+    srv.start()
+    entry = RosterEntry(name="p2", role="dp", host=srv.host, port=srv.port,
+                        public=(0, 0))
+    plan = FaultPlan(seed=0)
+    plan.add(FaultSpec(where="request", kind="close_mid_frame", target="p2",
+                       mtype="survey_dp"))
+    set_fault_plan(plan)
+    pol = RetryPolicy(connect_retries=5, backoff_s=0.01, backoff_cap_s=0.02,
+                      jitter=0.0, call_timeout_s=0.4, seed=0)
+    try:
+        with pytest.raises(ConnectionClosed, match="partial write"):
+            call_entry(entry, {"type": "survey_dp"}, policy=pol)
+        # the torn frame never reached the handler, and despite 5 allowed
+        # connect retries the contribution was NOT re-sent
+        assert calls == []
+    finally:
+        srv.stop()
+
+
+# -- quorum-degraded surveys over TCP ---------------------------------------
+
+def test_survey_quorum_degraded_dp_dead_at_dispatch(tmp_path):
+    rng = np.random.default_rng(101)
+    nodes, entries, datas, _ = _boot(
+        tmp_path, ["cn", "dp", "dp", "dp", "dp", "dp"], rng)
+    try:
+        client = RemoteClient(Roster(entries), rng, policy=FAST)
+        client.broadcast_roster()
+        plan = FaultPlan(seed=1)
+        plan.kill("dp1")
+        set_fault_plan(plan)
+        result = client.run_survey("sum", query_min=0, query_max=9,
+                                   survey_id="sv-quorum",
+                                   dlog=eg.DecryptionTable(limit=500),
+                                   min_dp_quorum=4)
+        want = int(sum(d.sum() for n, d in datas.items() if n != "dp1"))
+        assert result == want
+        assert client.last_responders == ["dp0", "dp2", "dp3", "dp4"]
+        assert client.last_absent == ["dp1"]
+        # strict mode (quorum 0 = all DPs) must refuse the same roster
+        with pytest.raises(RemoteError, match="responded"):
+            client.run_survey("sum", query_min=0, query_max=9,
+                              survey_id="sv-strict",
+                              dlog=eg.DecryptionTable(limit=500))
+    finally:
+        _stop(nodes)
+
+
+def test_survey_dp_dies_mid_contribution(tmp_path):
+    """The DP's reply is torn mid-frame AFTER its handler ran: the root
+    must not re-send the contribution, and the survey completes over the
+    remaining responders."""
+    rng = np.random.default_rng(102)
+    nodes, entries, datas, _ = _boot(
+        tmp_path, ["cn", "dp", "dp", "dp", "dp", "dp"], rng)
+    try:
+        client = RemoteClient(Roster(entries), rng, policy=FAST)
+        client.broadcast_roster()
+        plan = FaultPlan(seed=2)
+        plan.add(FaultSpec(where="reply", kind="close_mid_frame",
+                           target="dp2", mtype="survey_dp", count=1))
+        set_fault_plan(plan)
+        result = client.run_survey("sum", query_min=0, query_max=9,
+                                   survey_id="sv-midc",
+                                   dlog=eg.DecryptionTable(limit=500),
+                                   min_dp_quorum=4)
+        want = int(sum(d.sum() for n, d in datas.items() if n != "dp2"))
+        assert result == want
+        assert client.last_responders == ["dp0", "dp1", "dp3", "dp4"]
+        assert client.last_absent == ["dp2"]
+    finally:
+        _stop(nodes)
+
+
+def test_survey_seeded_chaos_is_deterministic(tmp_path):
+    """Acceptance bar: the same FaultPlan seed yields the same responder
+    set AND the same degraded aggregate across two runs."""
+    rng = np.random.default_rng(103)
+    nodes, entries, datas, _ = _boot(
+        tmp_path, ["cn", "dp", "dp", "dp", "dp", "dp"], rng)
+    try:
+        client = RemoteClient(Roster(entries), rng, policy=FAST)
+        client.broadcast_roster()
+
+        def chaos_run(survey_id):
+            plan = FaultPlan(seed=12)
+            plan.add(FaultSpec(where="connect", kind="refuse",
+                               target="dp*", prob=0.5))
+            set_fault_plan(plan)
+            pol = RetryPolicy(connect_retries=0, backoff_s=0.01,
+                              backoff_cap_s=0.02, jitter=0.0,
+                              call_timeout_s=rp.CALL_TIMEOUT_S, seed=0)
+            for n in nodes:
+                n.policy = pol        # one connect draw per DP, in order
+            result = client.run_survey("sum", query_min=0, query_max=9,
+                                       survey_id=survey_id,
+                                       dlog=eg.DecryptionTable(limit=500),
+                                       min_dp_quorum=1)
+            return result, list(client.last_responders), \
+                list(client.last_absent)
+
+        r1, resp1, abs1 = chaos_run("sv-det-a")
+        r2, resp2, abs2 = chaos_run("sv-det-b")
+        assert (r1, resp1, abs1) == (r2, resp2, abs2)
+        assert 1 <= len(resp1) < 5      # the coin actually fired
+        assert int(r1) == int(sum(datas[n].sum() for n in resp1))
+    finally:
+        _stop(nodes)
+
+
+def test_probe_liveness_skips_dead_roster_entries(tmp_path):
+    rng = np.random.default_rng(104)
+    nodes, entries, datas, _ = _boot(tmp_path, ["cn", "dp", "dp"], rng)
+    # a roster entry nothing listens on: allocate a port, then free it
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    entries = entries + [RosterEntry(name="dp2", role="dp",
+                                     host="127.0.0.1", port=dead_port,
+                                     public=entries[0].public)]
+    try:
+        client = RemoteClient(Roster(entries), rng, policy=FAST)
+        # the dead entry must not abort the broadcast
+        assert client.broadcast_roster() == {"cn0": True, "dp0": True,
+                                             "dp1": True, "dp2": False}
+        alive = client.probe_liveness()
+        assert alive == {"cn0": True, "dp0": True, "dp1": True,
+                         "dp2": False}
+        # probe=True excludes the dead DP before dispatch instead of
+        # paying a connect failure for it inside the survey
+        result = client.run_survey("sum", query_min=0, query_max=9,
+                                   survey_id="sv-probe",
+                                   dlog=eg.DecryptionTable(limit=500),
+                                   min_dp_quorum=2, probe=True)
+        assert result == int(sum(d.sum() for d in datas.values()))
+        assert client.last_responders == ["dp0", "dp1"]
+        assert client.last_absent == ["dp2"]
+    finally:
+        _stop(nodes)
+
+
+# -- VN quorum --------------------------------------------------------------
+
+def _proof_request_msg(req):
+    def pack_bytes(b):
+        return pack_array(np.frombuffer(b, dtype=np.uint8))
+
+    return {"type": "proof_request", "proof_type": req.proof_type,
+            "survey_id": req.survey_id, "sender_id": req.sender_id,
+            "differ_info": req.differ_info, "round_id": req.round_id,
+            "data": pack_bytes(req.data),
+            "signature": pack_bytes(req.signature.to_bytes())}
+
+
+def test_end_verification_vn_quorum(tmp_path):
+    """3 VNs expect one proof each; only 2 receive it. Strict
+    end_verification refuses; vn_quorum=2/3 commits and records the
+    straggler."""
+    from drynx_tpu.proofs import requests as rq
+
+    rng = np.random.default_rng(105)
+    nodes, entries, _, secrets_of = _boot(
+        tmp_path, ["cn", "vn", "vn", "vn"], rng)
+    try:
+        client = RemoteClient(Roster(entries), rng, policy=FAST)
+        client.broadcast_roster()
+        vns = [e for e in entries if e.role == "vn"]
+        for e in vns:
+            call_entry(e, {"type": "vn_register", "survey_id": "sv-vnq",
+                           "expected": 1, "proofs": False}, policy=FAST)
+        req = rq.new_proof_request("range", "sv-vnq", "cn0", "dp0", 0,
+                                   b"payload", secrets_of["cn0"])
+        for e in vns[:2]:                      # vn2 never gets its proof
+            call_entry(e, _proof_request_msg(req), policy=FAST)
+
+        with pytest.raises(RemoteError, match="complete bitmaps"):
+            call_entry(vns[0], {"type": "end_verification",
+                                "survey_id": "sv-vnq", "timeout": 1.0,
+                                "vn_quorum": 1.0},
+                       timeout=30.0, policy=FAST)
+        block = call_entry(vns[0], {"type": "end_verification",
+                                    "survey_id": "sv-vnq", "timeout": 5.0,
+                                    "vn_quorum": 2 / 3},
+                           timeout=30.0, policy=FAST)
+        assert block["vn_reported"] == ["vn0", "vn1"]
+        assert block["vn_absent"] == ["vn2"]
+        assert {k.split(":")[0] for k in block["bitmap"]} == {"vn0", "vn1"}
+    finally:
+        _stop(nodes)
+
+
+def test_vn_adjust_expected_retriggers_range_flush(tmp_path):
+    """A VN holding buffered range payloads flushes the joint check as
+    soon as the root shrinks the expected-proof counters to the responder
+    set — otherwise an absent DP stalls the survey forever."""
+    from drynx_tpu.proofs import requests as rq
+    from drynx_tpu.service.proof_collection import VerifyingNode
+
+    rng = np.random.default_rng(106)
+    x, pub = eg.keygen(rng)
+    flushes = []
+
+    def joint(datas, sid):
+        flushes.append(len(datas))
+        return [True] * len(datas)
+
+    vn = VerifyingNode("vn0", str(tmp_path / "vn.db"), {"cn0": pub},
+                       verify_fns={"range_joint": joint})
+    vn.register_survey("sv-adj", 3, {"range": 1.0}, expected_range=3)
+    for i in range(2):
+        req = rq.new_proof_request("range", "sv-adj", "cn0", f"dp{i}", 0,
+                                   b"payload-%d" % i, x)
+        assert vn.receive_proof(req) == rq.BM_RECVD
+    st = vn.surveys["sv-adj"]
+    assert flushes == [] and not st.done.is_set()
+
+    vn.adjust_expected("sv-adj", 1, expected_range=2)
+    assert flushes == [2]                       # flush fired on the adjust
+    assert st.done.is_set()
+    assert sorted(st.bitmap.values()) == [rq.BM_TRUE, rq.BM_TRUE]
+
+
+# -- full pipeline acceptance (proofs on) -----------------------------------
+
+@pytest.mark.slow
+def test_e2e_survey_dead_dp_and_straggling_vn(tmp_path):
+    """ISSUE acceptance: 1/5 DPs dead and 1/3 VNs unreachable; the survey
+    completes within the quorum path with the correct aggregate over the 4
+    responding DPs and an audit block carried by the 2 live VNs."""
+    from drynx_tpu.proofs import requests as rq
+
+    rng = np.random.default_rng(107)
+    roles = ["cn", "cn"] + ["dp"] * 5 + ["vn"] * 3
+    nodes, entries, datas, _ = _boot(tmp_path, roles, rng, policy=None)
+    try:
+        client = RemoteClient(Roster(entries), rng)
+        client.broadcast_roster()
+        plan = FaultPlan(seed=42)
+        plan.kill("dp4")
+        plan.kill("vn2")
+        set_fault_plan(plan)
+        result, block = client.run_survey(
+            "sum", query_min=0, query_max=9, proofs=True, ranges=[(4, 4)],
+            survey_id="sv-chaos-e2e", dlog=eg.DecryptionTable(limit=500),
+            timeout=rp.COLD_COMPILE_WAIT_S, min_dp_quorum=4,
+            vn_quorum=2 / 3, probe=True)
+
+        want = int(sum(d.sum() for n, d in datas.items() if n != "dp4"))
+        assert result == want
+        assert client.last_responders == ["dp0", "dp1", "dp2", "dp3"]
+        assert client.last_absent == ["dp4"]
+
+        assert block["vn_reported"] == ["vn0", "vn1"]
+        assert block["vn_absent"] == ["vn2"]
+        # 4 range + 1 aggregation + 2 keyswitch per live VN, all verified
+        bitmap = block["bitmap"]
+        assert len(bitmap) == 7 * 2, sorted(bitmap)
+        assert set(bitmap.values()) == {rq.BM_TRUE}, bitmap
+        assert {k.split(":")[0] for k in bitmap} == {"vn0", "vn1"}
+    finally:
+        _stop(nodes)
