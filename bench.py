@@ -210,10 +210,13 @@ def main():
 
         enable_compilation_cache()
 
+        from drynx_tpu import compilecache as cc
         from drynx_tpu.proofs import requests as rq
         from drynx_tpu.utils.timers import PhaseTimers
 
         PhaseTimers.echo = True  # stream phase completions to stderr live
+        cc.CompileStats.echo = True  # per-program AOT rows to stderr live
+        cc.install_cache_listener()  # count persistent-cache hits
 
         log("building proofs-on cluster (3 CN / 10 DP / 3 VN, "
             "thresholds=1.0)")
@@ -287,6 +290,10 @@ def main():
         # per-VN verify caches are cleared before the timed window (see
         # run() above), so verification compute is inside the measurement
         "verify_cache_cleared": True,
+        # AOT precompile accounting (drynx_tpu/compilecache): how many
+        # programs the main-thread warmup dispatched before the timed
+        # window, and how many came out of the persistent XLA cache
+        **cc.STATS.headline(),
     })
     log(f"headline recorded: proofs-on {dt:.4f}s = "
         f"{BASELINE_PROOFS_S / dt:.1f}x vs the 12.2s proofs-on baseline")

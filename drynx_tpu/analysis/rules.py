@@ -417,3 +417,108 @@ class HardcodedTimeout(Rule):
                     f"literal default {a.arg}={d.value!r} in '{fn.name}' — "
                     f"use a named constant from "
                     f"drynx_tpu/resilience/policy.py")
+
+
+# ---------------------------------------------------------------------------
+@register
+class ThreadTrace(Rule):
+    """First-touch jit tracing from a worker thread is the r05 segfault
+    class: partial_eval recurses roughly one C frame per traced equation,
+    the pairing kernels trace >10k equations, and non-main threads get half
+    the main thread's C stack — the process dies in the interpreter with no
+    Python traceback. All first-touch tracing must happen on the main
+    thread (the compilecache warmup) or under the shared compile lock.
+    Flags `threading.Thread(target=f)` where `f` is a function defined in
+    this module whose body calls a trace entry — a jit/pallas-decorated
+    function, a `bucketed(...)`/`jax.jit(...)`-bound name, or a bucketed-op
+    attribute — outside a `with <...lock...>:` block."""
+
+    id = "thread-trace"
+    summary = ("threading.Thread target reaches a jit/trace entry point "
+               "outside a compile lock — first-touch tracing off the main "
+               "thread can overflow the worker's C stack")
+
+    _ENTRY_FACTORIES = {"bucketed", "jit", "pjit"}
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        entries = self._trace_entry_names(mod)
+        if not entries:
+            return
+        defs = {f.name: f for f in mod.functions}
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if d not in ("threading.Thread", "Thread"):
+                continue
+            target = next((kw.value for kw in sub.keywords
+                           if kw.arg == "target"), None)
+            if target is None:
+                continue
+            if isinstance(target, ast.Lambda):
+                hit = self._unlocked_entry_call(target.body, entries,
+                                                under_lock=False)
+                if hit:
+                    yield self.finding(
+                        mod, sub,
+                        f"Thread target lambda calls trace entry "
+                        f"'{hit}' — first-touch tracing off the main "
+                        f"thread (warm it via drynx_tpu.compilecache or "
+                        f"wrap in the compile lock)")
+                continue
+            if not isinstance(target, ast.Name) or target.id not in defs:
+                continue  # dynamic/imported target: out of static reach
+            fn = defs[target.id]
+            hit = None
+            for stmt in fn.body:
+                hit = self._unlocked_entry_call(stmt, entries,
+                                                under_lock=False)
+                if hit:
+                    break
+            if hit:
+                yield self.finding(
+                    mod, sub,
+                    f"Thread target '{fn.name}' calls trace entry "
+                    f"'{hit}' outside a compile lock — first-touch "
+                    f"tracing off the main thread (warm it via "
+                    f"drynx_tpu.compilecache or wrap in the compile lock)")
+
+    def _trace_entry_names(self, mod: ModuleInfo) -> Set[str]:
+        names = {f.name for f in mod.traced_functions}
+        # names bound to bucketed(...)/jax.jit(...) factory calls anywhere
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Assign) \
+                    or not isinstance(sub.value, ast.Call):
+                continue
+            d = _dotted(sub.value.func) or ""
+            if d.split(".")[-1] in self._ENTRY_FACTORIES:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    @classmethod
+    def _unlocked_entry_call(cls, node: ast.AST, entries: Set[str],
+                             under_lock: bool) -> Optional[str]:
+        """Name of the first trace-entry call NOT under a lock-ish `with`,
+        else None. Recursion tracks `with ...lock...:` ancestry — ast.walk
+        can't, it loses parents."""
+        if isinstance(node, ast.With):
+            locked = under_lock or any(
+                "lock" in (_dotted(item.context_expr) or "").lower()
+                for item in node.items)
+            for child in node.body:
+                hit = cls._unlocked_entry_call(child, entries, locked)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Call) and not under_lock:
+            d = _dotted(node.func)
+            leaf = (d or "").split(".")[-1]
+            if leaf in entries:
+                return leaf
+        for child in ast.iter_child_nodes(node):
+            hit = cls._unlocked_entry_call(child, entries, under_lock)
+            if hit:
+                return hit
+        return None

@@ -1,0 +1,18 @@
+"""Kernel precompile + trace-dedup layer (see registry.py docstring).
+
+Public surface:
+  Profile, BENCH          — survey shape parameters for the program set
+  build_registry(profile) — enumerate ProgramSpecs (no tracing)
+  precompile(profile)     — serial trace/lower/compile driver
+  trace_guard()           — recursion-limit + thread-stack-size guard
+  STATS, CompileStats     — per-program timings + persistent-cache counters
+
+CLI: python -m drynx_tpu.precompile [--dry-run]
+"""
+from .registry import (BENCH, Profile, ProgramSpec, build_registry,
+                       precompile, trace_guard)
+from .stats import STATS, CompileStats, install_cache_listener
+
+__all__ = ["BENCH", "Profile", "ProgramSpec", "build_registry",
+           "precompile", "trace_guard", "STATS", "CompileStats",
+           "install_cache_listener"]

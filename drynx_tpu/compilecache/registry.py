@@ -1,0 +1,545 @@
+"""AOT kernel precompile registry: the finite program set of a proofs-on
+survey, declared as (kernel, bucket shape, dtype) entries.
+
+A cold process used to discover every program lazily, mid-survey, from
+whichever thread touched it first — tens of minutes of serialized trace +
+compile inside the timed bench window, and (worse) first-touch TRACING on
+`_async_proof` / dp_lists worker threads, whose default 8 MB C stacks
+overflow under partial_eval's recursion on the pairing kernels (the r05
+segfault class, service.py:500). This registry makes the program set
+explicit so it can be driven SERIALLY, on the MAIN thread, before any
+survey starts:
+
+  * the `bucketed()` crypto family (crypto/batching.py BUCKETED_OPS) at
+    the bucket sizes a proofs-on survey dispatches,
+  * the raw Pallas pairing entry points (miller / windowed-pow /
+    mulreduce8) at their flat dispatch shapes,
+  * the range-proof create/verify compositions (covered through the
+    bucketed primitives they dispatch — _commit_kernel, _response_kernel,
+    _verify_kernel and the RLC prelude are pure compositions),
+  * the fused exec pipeline (service._fused_enc/_agg/_ks/_dec).
+
+`jax.jit(...).lower(...).compile()` on each entry feeds the persistent XLA
+cache (utils/cache.py), so the next process pays lowering only. On CPU,
+`--dry-run` traces + lowers exactly the programs the CPU backend would
+dispatch (host-oracle detours and Pallas-only kernels are enumerated but
+skipped) — a fast structural check that every registered program still
+traces.
+
+Batch sizes derive from a Profile (defaults = the flagship bench survey:
+3 CNs, 10 DPs, V=9 logreg coefficients, (u=16, l=5) ranges). They are the
+canonical POST-bucketing shapes, so nearby survey configurations land on
+the same executables.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Callable
+
+from .stats import STATS, CompileStats, install_cache_listener
+
+NL = 16  # limbs per field element (crypto/params.py)
+
+
+@dataclasses.dataclass(frozen=True)
+class Profile:
+    """Survey shape parameters the program set derives from."""
+
+    n_cns: int = 3
+    n_dps: int = 10
+    n_values: int = 9       # V: logreg num_coeffs for pima d=8
+    u: int = 16             # range-proof base
+    l: int = 5              # range-proof digits
+    dlog_limit: int = 10000
+
+
+BENCH = Profile()
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One AOT program: zero-arg lower()/call() thunks + dispatch metadata.
+
+    lower() returns a jax.stages.Lowered (AOT: .compile() feeds the
+    persistent cache WITHOUT executing — but does NOT warm the jit's own
+    dispatch cache). call() dispatches the program the way runtime does —
+    it is the only way to guarantee later calls at these shapes re-use a
+    cached trace instead of retracing (LocalCluster warmup uses it)."""
+
+    name: str               # e.g. "bucketed:pair@2048"
+    op: str                 # registry family key (BUCKETED_OPS name, ...)
+    kind: str               # "bucketed" | "pallas" | "fused"
+    phase: str              # survey phase that dispatches it (doc only)
+    lower: Callable[[], object]
+    dispatched: Callable[[], bool]
+    call: Callable[[], object] | None = None
+
+
+# ---------------------------------------------------------------------------
+# Backend dispatch predicates (must mirror crypto/batching.py host_dispatch)
+# ---------------------------------------------------------------------------
+
+def _pallas_on() -> bool:
+    from ..crypto import pallas_ops as po
+
+    return po.available()
+
+
+def _kernel_route_pairing() -> bool:
+    """True iff the pairing-family bucketed kernels actually dispatch
+    (host_dispatch detours them to the host oracle on CPU)."""
+    from ..crypto import host_oracle as ho
+
+    return not (ho.ENABLED and not _pallas_on())
+
+
+def _kernel_route_g1() -> bool:
+    """G1/G2 family: detours to host only when the NATIVE library built
+    (gate=npair.available in batching._build)."""
+    from ..crypto import host_oracle as ho
+    from ..crypto import native_pairing as npair
+
+    return not (ho.ENABLED and not _pallas_on() and npair.available())
+
+
+_GATES = {
+    "device": lambda: True,
+    "pairing": _kernel_route_pairing,
+    "g1": _kernel_route_g1,
+    "pallas": _pallas_on,
+}
+
+
+# ---------------------------------------------------------------------------
+# Example-argument templates (zeros: trace/lower/compile never execute)
+# ---------------------------------------------------------------------------
+
+def _z(shape, dtype=None):
+    import jax.numpy as jnp
+
+    return jnp.zeros(shape, dtype or jnp.uint32)
+
+
+def _scalar(b):
+    return _z((b, NL))
+
+
+def _g1(b):
+    return _z((b, 3, NL))
+
+
+def _g2(b):
+    return _z((b, 3, 2, NL))
+
+
+def _gt(b):
+    return _z((b, 6, 2, NL))
+
+
+def _ct(b):
+    return _z((b, 2, 3, NL))
+
+
+def _coord(b):
+    return _z((b, NL))
+
+
+def _fp2c(b):
+    return _z((b, 2, NL))
+
+
+def _i64(b):
+    import jax.numpy as jnp
+    import numpy as np
+
+    # canonicalized like service.run_survey's jnp.asarray(dp_stats)
+    return jnp.asarray(np.zeros((b,), dtype=np.int64))
+
+
+def _fb_table():
+    return _z((64, 16, 3, NL))          # eg.FixedBase.table
+
+
+def _pow_tables(p: Profile):
+    return _z((p.n_cns * p.u, 64, 16, 6, 2, NL))  # sig_gt_pow_tables
+
+
+# Each bucketed entry: op -> (args builder(profile, B), batch exprs, phase,
+# gate). Batch exprs are evaluated on the profile; the wrapper's bucket_of
+# canonicalizes them, and entries landing on the same bucket dedupe.
+_B_SCHEMAS: list = [
+    # --- DataCollection / DRO / keyswitch helpers (device everywhere) ---
+    ("encrypt", lambda p, b: (_fb_table(), _fb_table(), _scalar(b),
+                              _scalar(b)),
+     [lambda p: p.n_dps * p.n_values], "DataCollection", "device"),
+    ("int_to_scalar", lambda p, b: (_i64(b),),
+     [lambda p: p.n_dps * p.n_values * p.l], "RangeProofCreate", "device"),
+    ("ct_add", lambda p, b: (_ct(b), _ct(b)),
+     [lambda p: p.n_values], "Aggregation", "device"),
+    ("ct_scalar_mul", lambda p, b: (_ct(b), _scalar(b)),
+     [lambda p: p.n_values], "Obfuscation", "device"),
+    ("decrypt_point", lambda p, b: (_ct(b), _scalar(b)),
+     [lambda p: p.n_values], "Decryption", "device"),
+    ("is_infinity", lambda p, b: (_g1(b),),
+     [lambda p: p.n_values], "Decryption", "device"),
+    ("table_lookup",
+     lambda p, b: (_z((2 * p.dlog_limit,)), _z((2 * p.dlog_limit, NL)),
+                   _z((2 * p.dlog_limit,)),
+                   _z((2 * p.dlog_limit,), "int32"), _g1(b)),
+     [lambda p: p.n_values], "Decryption", "device"),
+    # --- scalar-field (mod n) family: creation + response + RLC weights ---
+    ("fn_add", lambda p, b: (_scalar(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values,
+      lambda p: p.n_dps * p.n_values * p.l,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "device"),
+    ("fn_sub", lambda p, b: (_scalar(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values,
+      lambda p: p.n_dps * p.n_values * p.l,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "device"),
+    ("fn_neg", lambda p, b: (_scalar(b),),
+     [lambda p: p.n_dps * p.n_values * p.l,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "device"),
+    ("fn_mul_plain", lambda p, b: (_scalar(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values,
+      lambda p: p.n_dps * p.n_values * p.l,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "device"),
+    ("fn_mont_mul", lambda p, b: (_scalar(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "device"),
+    # --- canonical byte encoders (wire format, proofs/encoding.py) ---
+    ("from_mont_p", lambda p, b: (_scalar(b),),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofWire", "device"),
+    ("to_mont_p", lambda p, b: (_scalar(b),),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofWire", "device"),
+    # --- G1/G2 family (host-native detour on CPU when the lib built) ---
+    ("g1_add", lambda p, b: (_g1(b), _g1(b)),
+     [lambda p: p.n_dps * p.n_values,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "g1"),
+    ("g1_neg", lambda p, b: (_g1(b),),
+     [lambda p: p.n_dps * p.n_values], "RangeProofVerify", "g1"),
+    ("g1_scalar_mul", lambda p, b: (_g1(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values,
+      lambda p: p.n_cns * p.n_dps * p.n_values],
+     "RangeProofVerify", "g1"),
+    ("g1_scalar_mul64", lambda p, b: (_g1(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values], "RangeProofVerify", "g1"),
+    ("g1_eq", lambda p, b: (_g1(b), _g1(b)),
+     [lambda p: p.n_dps * p.n_values], "RangeProofVerify", "g1"),
+    ("g1_normalize", lambda p, b: (_g1(b),),
+     [lambda p: p.n_dps * p.n_values * p.l,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "g1"),
+    ("fixed_base_mul", lambda p, b: (_fb_table(), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values,
+      lambda p: p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "g1"),
+    ("g2_scalar_mul", lambda p, b: (_g2(b), _scalar(b)),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "g1"),
+    ("g2_normalize", lambda p, b: (_g2(b),),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "g1"),
+    # --- pairing family (host-oracle detour on CPU) ---
+    ("pair", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofVerify", "pairing"),
+    ("miller", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+     [lambda p: p.n_cns * p.u], "SigTableSetup", "pairing"),
+    ("gt_pow", lambda p, b: (_gt(b), _scalar(b)),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "pairing"),
+    ("gt_pow64", lambda p, b: (_gt(b), _scalar(b)),
+     [lambda p: p.n_dps * p.n_values], "RangeProofVerify", "pairing"),
+    ("gt_pow128", lambda p, b: (_gt(b), _scalar(b)),
+     [lambda p: 1], "GTOrderGate", "pairing"),
+    ("final_exp", lambda p, b: (_gt(b),),
+     [lambda p: 1], "RangeProofVerify", "pairing"),
+    ("gt_mul", lambda p, b: (_gt(b), _gt(b)),
+     [lambda p: p.n_dps * p.n_values * p.l,
+      lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "pairing"),
+    # --- pure-device GT helpers ---
+    ("gt_eq", lambda p, b: (_gt(b), _gt(b)),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofVerify", "device"),
+    ("gt_frob1", lambda p, b: (_gt(b),),
+     [lambda p: 1], "GTMembershipGate", "device"),
+    ("gt_frob2", lambda p, b: (_gt(b),),
+     [lambda p: 1], "GTMembershipGate", "device"),
+    # --- Pallas-only bucketed ops (lazy wrappers in proofs/range_proof) ---
+    ("gt_pow_fixed_multi",
+     lambda p, b: (_pow_tables(p), _z((b,), "int32"), _scalar(b)),
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "pallas"),
+    ("gt_pow_gtb", lambda p, b: (_scalar(b),),
+     [lambda p: p.n_dps * p.n_values * p.l],
+     "RangeProofCreate", "pallas"),
+]
+
+# Raw Pallas flat entry points the bucketed family dispatches internally on
+# TPU. Registered explicitly so their Mosaic compiles land in the
+# persistent cache even for call sites outside bucketed wrappers
+# (g2.scalar_mul, fp12 pow paths, gt_pow_fixed's mulreduce passes).
+_FLAT = 2048  # the pairing family's max_bucket: every big batch chunks to it
+
+
+def _pallas_specs(p: Profile) -> list:
+    def miller(do="lower"):
+        from ..crypto import pallas_pairing as pp
+
+        args = (_coord(_FLAT), _coord(_FLAT), _fp2c(_FLAT), _fp2c(_FLAT))
+        if do == "call":
+            return pp.miller_flat(*args)
+        return pp._miller_flat.lower(*args, interpret=False)
+
+    def wpow(n_bits, do="lower"):
+        def go():
+            from ..crypto import pallas_pairing as pp
+
+            if do == "call":
+                return pp.f12_wpow_flat(_gt(_FLAT), _scalar(_FLAT),
+                                        n_bits=n_bits, cyc=True)
+            return pp._f12_wpow_flat.lower(
+                _gt(_FLAT), _scalar(_FLAT), n_bits=n_bits, wbits=3,
+                cyc=True, interpret=False)
+        return go
+
+    def mulreduce8(do="lower"):
+        from ..crypto import pallas_pairing as pp
+
+        g = _z((_FLAT, 8, 6, 2, NL))
+        if do == "call":
+            return pp.f12_mulreduce8_flat(g)
+        return pp._f12_mulreduce8_flat.lower(g, interpret=False)
+
+    return [
+        ProgramSpec(f"pallas:miller_flat@{_FLAT}", "miller_flat", "pallas",
+                    "Pairing", miller, _pallas_on,
+                    lambda: miller("call")),
+        ProgramSpec(f"pallas:f12_wpow_flat@{_FLAT}/63c", "f12_wpow_flat",
+                    "pallas", "RangeProofVerify", wpow(63), _pallas_on,
+                    wpow(63, "call")),
+        ProgramSpec(f"pallas:f12_wpow_flat@{_FLAT}/128c", "f12_wpow_flat",
+                    "pallas", "GTOrderGate", wpow(128), _pallas_on,
+                    wpow(128, "call")),
+        ProgramSpec(f"pallas:f12_wpow_flat@{_FLAT}/256c", "f12_wpow_flat",
+                    "pallas", "RangeProofCreate", wpow(256), _pallas_on,
+                    wpow(256, "call")),
+        ProgramSpec(f"pallas:f12_mulreduce8_flat@{_FLAT}",
+                    "f12_mulreduce8_flat", "pallas", "RangeProofCreate",
+                    mulreduce8, _pallas_on, lambda: mulreduce8("call")),
+    ]
+
+
+def _fused_specs(p: Profile) -> list:
+    """The fused exec pipeline (service.py module-level jits), at the exact
+    survey shapes run_survey dispatches."""
+    V, nd, nc, T = p.n_values, p.n_dps, p.n_cns, 2 * p.dlog_limit
+
+    def enc(do="lower"):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..service import service as svc
+
+        args = (_fb_table(),
+                jnp.asarray(np.zeros((nd, V), dtype=np.int64)),
+                _z((nd, V, NL)))
+        return (svc._fused_enc(*args) if do == "call"
+                else svc._fused_enc.lower(*args))
+
+    def agg(do="lower"):
+        from ..service import service as svc
+
+        a = (_z((nd, V, 2, 3, NL)),)
+        return (svc._fused_agg(*a) if do == "call"
+                else svc._fused_agg.lower(*a))
+
+    def ks(do="lower"):
+        import jax.numpy as jnp
+
+        from ..service import service as svc
+
+        args = (_fb_table(), _z((V, 2, 3, NL)), _z((nc, V, NL)),
+                _z((nc, NL)), jnp.asarray(0, dtype=jnp.int64))
+        return (svc._fused_ks(*args) if do == "call"
+                else svc._fused_ks.lower(*args))
+
+    def dec(do="lower"):
+        from ..service import service as svc
+
+        args = (_z((V, 2, 3, NL)), _z((NL,)), _z((T,)), _z((T, NL)),
+                _z((T,)), _z((T,), "int32"))
+        return (svc._fused_dec(*args) if do == "call"
+                else svc._fused_dec.lower(*args))
+
+    mk = lambda nm, th, ph: ProgramSpec(f"fused:{nm}", nm, "fused", ph, th,
+                                        lambda: True,
+                                        lambda th=th: th("call"))
+    return [mk("enc", enc, "DataCollection"), mk("agg", agg, "Aggregation"),
+            mk("ks", ks, "KeySwitching"), mk("dec", dec, "Decryption")]
+
+
+def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
+    """Enumerate the proofs-on program set for `profile`.
+
+    Entries landing on the same (op, bucket) dedupe; the returned order is
+    cheap-first (fn family before pairings) so an interrupted precompile
+    still banks the most programs per second."""
+    from ..crypto import batching as B
+    from ..proofs import range_proof as rp
+
+    # force-build the lazy bucketed wrappers so BUCKETED_OPS is complete
+    # (the gtB table build is host work — TPU path only)
+    rp.aot_register_bucketed(build_gtb_table=_pallas_on())
+
+    specs: dict[str, ProgramSpec] = {}
+    for op, args_fn, batches, phase, gate in _B_SCHEMAS:
+        w = B.BUCKETED_OPS.get(op)
+        for bexpr in batches:
+            batch = int(bexpr(profile))
+            if w is not None:
+                bucket = w.bucket_of(batch)
+            else:
+                # lazy Pallas-only op not built on this backend: name by
+                # its known (min=32, max=2048) bucket config
+                bucket = min(max(32, 1 << (batch - 1).bit_length()), 2048)
+            name = f"bucketed:{op}@{bucket}"
+            if name in specs:
+                continue
+
+            def lower(op=op, args_fn=args_fn, bucket=bucket):
+                from ..crypto.batching import BUCKETED_OPS
+
+                return BUCKETED_OPS[op].lower(*args_fn(profile, bucket))
+
+            def call(op=op, args_fn=args_fn, bucket=bucket):
+                from ..crypto.batching import BUCKETED_OPS
+
+                return BUCKETED_OPS[op](*args_fn(profile, bucket))
+
+            specs[name] = ProgramSpec(name, op, "bucketed", phase, lower,
+                                      _GATES[gate], call)
+    for s in _pallas_specs(profile) + _fused_specs(profile):
+        specs[s.name] = s
+    return list(specs.values())
+
+
+# ---------------------------------------------------------------------------
+# Serial driver
+# ---------------------------------------------------------------------------
+
+def precompile(profile: Profile = BENCH, mode: str = "compile",
+               stats: CompileStats | None = None,
+               log: Callable[[str], None] | None = None) -> CompileStats:
+    """Drive every dispatched program, SERIALLY.
+
+    mode:
+      "lower"   — trace + lower only (--dry-run; CPU-safe, no executable)
+      "compile" — AOT .lower().compile(): feeds the persistent XLA cache
+                  without executing (the CLI default). NOTE this does NOT
+                  warm the jits' own dispatch caches — runtime calls still
+                  trace once (cheap) and then hit the persistent cache.
+      "execute" — dispatch each program exactly like runtime does, with
+                  zero-valued canonical-shape inputs. The only mode that
+                  leaves the dispatch caches warm, so later survey calls
+                  at these shapes perform ZERO tracing — LocalCluster's
+                  main-thread warmup uses it.
+
+    Serial is load-bearing: XLA's CPU compiler has segfaulted under
+    concurrent compiles (service._async_proof docstring), and the
+    persistent-cache write path assumes one writer per key."""
+    assert mode in ("lower", "compile", "execute"), mode
+    import jax
+
+    stats = stats or STATS
+    listener = install_cache_listener()
+    if log is None:
+        log = lambda m: print(f"[precompile] {m}", file=sys.stderr,
+                              flush=True)
+    specs = build_registry(profile)
+    log(f"{len(specs)} programs registered (mode={mode})")
+    errors = 0
+    for spec in specs:
+        if not spec.dispatched():
+            stats.record(spec.name, "skipped",
+                         detail="not dispatched on this backend")
+            continue
+        t0 = time.perf_counter()
+        try:
+            h0 = stats.listener_hits
+            if mode == "execute":
+                jax.block_until_ready(spec.call())
+                t1 = time.perf_counter()
+                cache = None
+                if listener:
+                    cache = ("hit" if stats.listener_hits > h0
+                             else "miss")
+                stats.record(spec.name, "executed", lower_s=t1 - t0,
+                             cache=cache)
+                continue
+            lowered = spec.lower()
+            t1 = time.perf_counter()
+            if mode == "lower":
+                stats.record(spec.name, "lowered", lower_s=t1 - t0)
+                continue
+            lowered.compile()
+            t2 = time.perf_counter()
+            cache = None
+            if listener:
+                cache = "hit" if stats.listener_hits > h0 else "miss"
+            stats.record(spec.name, "compiled", lower_s=t1 - t0,
+                         compile_s=t2 - t1, cache=cache)
+        except Exception as e:  # record + keep going; CLI exits nonzero
+            errors += 1
+            stats.record(spec.name, "error",
+                         lower_s=time.perf_counter() - t0,
+                         detail=f"{type(e).__name__}: {e}")
+    t = stats.totals()
+    log(f"done: {t['compiled']} compiled / {t['executed']} executed / "
+        f"{t['lowered']} lowered / {t['skipped']} skipped / "
+        f"{errors} errors; lower {t['lower_seconds']:.1f}s compile "
+        f"{t['compile_seconds']:.1f}s")
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Trace-safety guard (the r05 segfault class)
+# ---------------------------------------------------------------------------
+
+_GUARDED = False
+
+
+def trace_guard(min_recursion: int = 20000,
+                stack_bytes: int = 64 * 1024 * 1024) -> None:
+    """Make first-touch tracing survivable anywhere it happens.
+
+    partial_eval recurses ~1 Python frame per traced equation; the pairing
+    kernels reach >10k frames. Two failure modes guarded here:
+      * RecursionError on the MAIN thread (recursion limit too low),
+      * a C-STACK overflow (segfault, not an exception) on WORKER threads,
+        whose default 8 MB stacks are half the main thread's — the r05
+        crash tracing pair_flat from a dp_lists proof thread.
+    threading.stack_size applies to threads created AFTER this call, so
+    LocalCluster runs it in __init__, before any _async_proof thread."""
+    global _GUARDED
+    if _GUARDED:
+        return
+    if sys.getrecursionlimit() < min_recursion:
+        sys.setrecursionlimit(min_recursion)
+    try:
+        import threading
+
+        threading.stack_size(stack_bytes)
+    except (ValueError, RuntimeError, OverflowError):
+        pass  # platform cap; recursion limit still protects the main thread
+    _GUARDED = True

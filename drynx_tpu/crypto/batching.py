@@ -23,8 +23,42 @@ def _next_bucket(b: int, min_bucket: int = 8) -> int:
     return p
 
 
+def _trace_mode():
+    """Hashable snapshot of the process state that changes WHAT a kernel
+    trace means: the interpret flags (tests monkeypatch both modules).
+    Each mode gets its own jax.jit object, so flipping INTERPRET can never
+    reuse a trace built under the other mode — the leak that used to force
+    jax.clear_caches() teardowns in the interpret-mode test fixtures."""
+    from . import pallas_ops as po
+    from . import pallas_pairing as pp
+
+    return (bool(po.INTERPRET), bool(pp.INTERPRET))
+
+
+def _freeze(tree):
+    leaves, treedef = jax.tree.flatten(tree)
+    return (tuple(leaves), treedef)
+
+
+# (fn, tail_ranks, out_tail_ranks, min_bucket, max_bucket) -> wrapper.
+# Keyed on fn IDENTITY: a second bucketed() call on the same function with
+# the same config returns the SAME wrapper, so every call site (range_proof
+# lazy wrappers, the precompile registry, tests) shares one jit cache and
+# each program traces once per process instead of once per call site.
+_BUCKETED_MEMO: dict = {}
+# name -> wrapper, for the precompile registry's enumeration
+BUCKETED_OPS: dict = {}
+
+# Optional trace-entry hook: called as TRACE_HOOK(op_name) each time an
+# inner jit actually TRACES its function (jit cache miss). Bucketed fn
+# bodies run only at trace time, so this observes real retraces — tests
+# use it to assert trace dedup and that no tracing happens off the main
+# thread (tests/test_batching.py, tests/test_service_tracing.py).
+TRACE_HOOK = None
+
+
 def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
-             max_bucket: int | None = None):
+             max_bucket: int | None = None, name: str | None = None):
     """Wrap fn so all leading batch dims are flattened + bucket-padded.
 
     The wrapped fn is jitted as ONE executable per bucket size, so repeated
@@ -47,10 +81,40 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
     rank of that argument's per-element (non-batch) suffix, or -1 to pass the
     argument through untouched (constant tables etc., not batched).
     out_tail_ranks: pytree matching fn's output, same meaning.
-    """
-    fn = jax.jit(fn)
 
-    def wrapped(*args):
+    Wrappers are MEMOIZED on (fn, tail_ranks, out_tail_ranks, min_bucket,
+    max_bucket): a second call with the same config returns the same wrapper
+    object, so each (op, bucket) program traces once per process no matter
+    how many call sites build it. `name` registers the wrapper in
+    BUCKETED_OPS for the precompile registry (drynx_tpu/compilecache).
+    """
+    key = (fn, _freeze(tail_ranks), _freeze(out_tail_ranks),
+           min_bucket, max_bucket)
+    cached = _BUCKETED_MEMO.get(key)
+    if cached is not None:
+        if name:
+            BUCKETED_OPS.setdefault(name, cached)
+        return cached
+
+    jits: dict = {}  # trace mode -> jax.jit object (own trace cache)
+    hook_name = name or getattr(fn, "__qualname__", "?")
+
+    def _traced_fn(*a, **k):
+        hook = TRACE_HOOK
+        if hook is not None:
+            hook(hook_name)
+        return fn(*a, **k)
+
+    def _jit():
+        mode = _trace_mode()
+        j = jits.get(mode)
+        if j is None:
+            j = jits[mode] = jax.jit(_traced_fn)
+        return j
+
+    def _canon(args):
+        """Flatten leading batch dims and pad to the bucket — the exact
+        canonical shapes the inner jit sees at runtime."""
         leaves, treedef = jax.tree.flatten(tuple(args),
                                            is_leaf=lambda x: x is None)
         ranks = jax.tree.flatten(tail_ranks)[0]
@@ -74,6 +138,11 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
                 pad = jnp.broadcast_to(lb[:1], (Bp - B,) + tail)
                 lb = jnp.concatenate([lb, pad], axis=0)
             flat.append(lb)
+        return treedef, ranks, flat, batch, B, Bp
+
+    def wrapped(*args):
+        treedef, ranks, flat, batch, B, Bp = _canon(args)
+        fn_ = _jit()
 
         out_ranks = jax.tree.flatten(out_tail_ranks)[0]
         if max_bucket is not None and Bp > max_bucket:
@@ -81,13 +150,13 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
             for s in range(0, Bp, max_bucket):
                 part = [l if r < 0 else l[s:s + max_bucket]
                         for l, r in zip(flat, ranks)]
-                chunks.append(fn(*treedef.unflatten(part)))
+                chunks.append(fn_(*treedef.unflatten(part)))
             chunk_leaves = [jax.tree.flatten(c)[0] for c in chunks]
             out_def = jax.tree.flatten(chunks[0])[1]
             out_leaves = [jnp.concatenate([c[i] for c in chunk_leaves], 0)
                           for i in range(len(chunk_leaves[0]))]
         else:
-            out = fn(*treedef.unflatten(flat))
+            out = fn_(*treedef.unflatten(flat))
             out_leaves, out_def = jax.tree.flatten(out)
 
         res = []
@@ -97,6 +166,29 @@ def bucketed(fn, tail_ranks, out_tail_ranks, min_bucket: int = 8,
             res.append(o.reshape(batch + tail))
         return out_def.unflatten(res)
 
+    def lower(*args):
+        """AOT entry: trace + lower the inner jit at the exact canonical
+        (bucketed) shapes `wrapped(*args)` would dispatch, WITHOUT
+        executing. Returns the jax.stages.Lowered; .compile() on it feeds
+        the persistent compilation cache (drynx_tpu/compilecache)."""
+        treedef, ranks, flat, batch, B, Bp = _canon(args)
+        if max_bucket is not None and Bp > max_bucket:
+            flat = [l if r < 0 else l[:max_bucket]
+                    for l, r in zip(flat, ranks)]
+        return _jit().lower(*treedef.unflatten(flat))
+
+    def bucket_of(B: int) -> int:
+        b = _next_bucket(int(B), min_bucket)
+        return b if max_bucket is None else min(b, max_bucket)
+
+    wrapped.lower = lower
+    wrapped.bucket_of = bucket_of
+    wrapped.config = {"tail_ranks": tail_ranks,
+                      "out_tail_ranks": out_tail_ranks,
+                      "min_bucket": min_bucket, "max_bucket": max_bucket}
+    _BUCKETED_MEMO[key] = wrapped
+    if name:
+        BUCKETED_OPS.setdefault(name, wrapped)
     return wrapped
 
 
@@ -188,30 +280,35 @@ def _build():
     _ng = npair.available
     g["g1_add"] = host_dispatch(
         _ho_early.g1_add_host, (2, 2),
-        bucketed(C.add, (2, 2), 2, max_bucket=4096), gate=_ng)
+        bucketed(C.add, (2, 2), 2, max_bucket=4096, name="g1_add"),
+        gate=_ng)
     g["g1_neg"] = host_dispatch(
         _ho_early.g1_neg_host, (2,),
-        bucketed(C.neg, (2,), 2, max_bucket=4096), gate=_ng)
+        bucketed(C.neg, (2,), 2, max_bucket=4096, name="g1_neg"), gate=_ng)
     g["g1_scalar_mul"] = host_dispatch(
         _ho_early.g1_scalar_mul_host, (2, 1),
-        bucketed(C.scalar_mul, (2, 1), 2, max_bucket=4096), gate=_ng)
+        bucketed(C.scalar_mul, (2, 1), 2, max_bucket=4096,
+                 name="g1_scalar_mul"), gate=_ng)
     g["g1_eq"] = host_dispatch(
         _ho_early.g1_eq_host, (2, 2),
-        bucketed(C.eq, (2, 2), 0, max_bucket=4096), gate=_ng)
+        bucketed(C.eq, (2, 2), 0, max_bucket=4096, name="g1_eq"), gate=_ng)
     g["g1_normalize"] = host_dispatch(
         _ho_early.g1_normalize_host, (2,),
-        bucketed(C.normalize, (2,), (1, 1, 0), max_bucket=4096), gate=_ng)
+        bucketed(C.normalize, (2,), (1, 1, 0), max_bucket=4096,
+                 name="g1_normalize"), gate=_ng)
     g["g2_scalar_mul"] = host_dispatch(
         _ho_early.g2_scalar_mul_host, (3, 1),
         bucketed(G2.scalar_mul, (3, 1), 3, min_bucket=32,
-                 max_bucket=2048), gate=_ng)
+                 max_bucket=2048, name="g2_scalar_mul"), gate=_ng)
     g["g2_normalize"] = host_dispatch(
         _ho_early.g2_normalize_host, (3,),
         bucketed(G2.normalize, (3,), (2, 2, 0),
-                 min_bucket=32, max_bucket=2048), gate=_ng)
+                 min_bucket=32, max_bucket=2048, name="g2_normalize"),
+        gate=_ng)
     g["fixed_base_mul"] = host_dispatch(
         _ho_early.fixed_base_mul_host, (-1, 1),
-        bucketed(eg.fixed_base_mul, (-1, 1), 2, max_bucket=4096), gate=_ng)
+        bucketed(eg.fixed_base_mul, (-1, 1), 2, max_bucket=4096,
+                 name="fixed_base_mul"), gate=_ng)
     from . import pallas_ops as po
     from . import pallas_pairing as ppair
 
@@ -278,57 +375,72 @@ def _build():
 
     g["pair"] = host_dispatch(
         ho.pair_host, (1, 1, 2, 2),
-        bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32, max_bucket=2048))
+        bucketed(_pair_fn, (1, 1, 2, 2), 3, min_bucket=32, max_bucket=2048,
+                 name="pair"))
     g["gt_frob2"] = bucketed(_gt_frob2_fn, (3,), 3, min_bucket=32,
-                             max_bucket=2048)
+                             max_bucket=2048, name="gt_frob2")
     g["gt_frob1"] = bucketed(_gt_frob1_fn, (3,), 3, min_bucket=32,
-                             max_bucket=2048)
+                             max_bucket=2048, name="gt_frob1")
     g["g1_scalar_mul64"] = host_dispatch(
         ho.g1_scalar_mul64_host, (2, 1),
         bucketed(lambda p, k: C.scalar_mul_short(p, k, 64), (2, 1), 2,
-                 max_bucket=4096), gate=_ng)
+                 max_bucket=4096, name="g1_scalar_mul64"), gate=_ng)
     g["miller"] = host_dispatch(
         ho.miller_host, (1, 1, 2, 2),
         bucketed(_miller_fn, (1, 1, 2, 2), 3, min_bucket=32,
-                 max_bucket=2048))
+                 max_bucket=2048, name="miller"))
     g["gt_pow"] = host_dispatch(
         ho.gt_pow_host, (3, 1),
-        bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32, max_bucket=2048))
+        bucketed(_gt_pow_fn, (3, 1), 3, min_bucket=32, max_bucket=2048,
+                 name="gt_pow"))
     g["gt_pow64"] = host_dispatch(
         ho.gt_pow_host, (3, 1),
-        bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32, max_bucket=2048))
+        bucketed(_gt_pow64_fn, (3, 1), 3, min_bucket=32, max_bucket=2048,
+                 name="gt_pow64"))
     g["gt_pow128"] = host_dispatch(
         ho.gt_pow_host, (3, 1),
-        bucketed(_gt_pow128_fn, (3, 1), 3, min_bucket=32, max_bucket=2048))
+        bucketed(_gt_pow128_fn, (3, 1), 3, min_bucket=32, max_bucket=2048,
+                 name="gt_pow128"))
     g["final_exp"] = host_dispatch(
         ho.final_exp_host, (3,),
-        bucketed(_final_exp_fn, (3,), 3, min_bucket=8, max_bucket=2048))
+        bucketed(_final_exp_fn, (3,), 3, min_bucket=8, max_bucket=2048,
+                 name="final_exp"))
     g["gt_mul"] = host_dispatch(
         ho.gt_mul_host, (3, 3),
-        bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32, max_bucket=2048))
-    g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32, max_bucket=2048)
-    g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1)
-    g["fn_sub"] = bucketed(lambda a, b: F.sub(a, b, FN), (1, 1), 1)
-    g["fn_neg"] = bucketed(lambda a: F.neg(a, FN), (1,), 1)
+        bucketed(_gt_mul_fn, (3, 3), 3, min_bucket=32, max_bucket=2048,
+                 name="gt_mul"))
+    g["gt_eq"] = bucketed(F12.eq, (3, 3), 0, min_bucket=32, max_bucket=2048,
+                          name="gt_eq")
+    g["fn_add"] = bucketed(lambda a, b: F.add(a, b, FN), (1, 1), 1,
+                           name="fn_add")
+    g["fn_sub"] = bucketed(lambda a, b: F.sub(a, b, FN), (1, 1), 1,
+                           name="fn_sub")
+    g["fn_neg"] = bucketed(lambda a: F.neg(a, FN), (1,), 1, name="fn_neg")
     g["fn_mul_plain"] = bucketed(
-        lambda a, b: F.mont_mul(F.to_mont(a, FN), b, FN), (1, 1), 1)
-    g["fn_mont_mul"] = bucketed(lambda a, b: F.mont_mul(a, b, FN), (1, 1), 1)
+        lambda a, b: F.mont_mul(F.to_mont(a, FN), b, FN), (1, 1), 1,
+        name="fn_mul_plain")
+    g["fn_mont_mul"] = bucketed(lambda a, b: F.mont_mul(a, b, FN), (1, 1), 1,
+                                name="fn_mont_mul")
     # ElGamal layer (ciphertext tail = (2, 3, 16))
-    g["encrypt"] = bucketed(eg.encrypt_with_tables, (-1, -1, 1, 1), 3)
-    g["int_to_scalar"] = bucketed(eg.int_to_scalar, (0,), 1)
+    g["encrypt"] = bucketed(eg.encrypt_with_tables, (-1, -1, 1, 1), 3,
+                            name="encrypt")
+    g["int_to_scalar"] = bucketed(eg.int_to_scalar, (0,), 1,
+                                  name="int_to_scalar")
     g["table_lookup"] = bucketed(eg._table_lookup, (-1, -1, -1, -1, 2),
-                                 (0, 0))
-    g["ct_add"] = bucketed(eg.ct_add, (3, 3), 3)
-    g["ct_scalar_mul"] = bucketed(eg.ct_scalar_mul, (3, 1), 3)
-    g["decrypt_point"] = bucketed(eg.decrypt_point, (3, 1), 2)
-    g["is_infinity"] = bucketed(C.is_infinity, (2,), 0)
+                                 (0, 0), name="table_lookup")
+    g["ct_add"] = bucketed(eg.ct_add, (3, 3), 3, name="ct_add")
+    g["ct_scalar_mul"] = bucketed(eg.ct_scalar_mul, (3, 1), 3,
+                                  name="ct_scalar_mul")
+    g["decrypt_point"] = bucketed(eg.decrypt_point, (3, 1), 2,
+                                  name="decrypt_point")
+    g["is_infinity"] = bucketed(C.is_infinity, (2,), 0, name="is_infinity")
     # Montgomery -> plain conversion for the canonical byte encoders
     # (proofs/encoding.py): unbucketed they re-compile per raw tensor
     # shape — the Fermat inverse in normalize is a 256-step scan
     g["from_mont_p"] = bucketed(lambda x: F.from_mont(x, F.FP), (1,), 1,
-                                max_bucket=8192)
+                                max_bucket=8192, name="from_mont_p")
     g["to_mont_p"] = bucketed(lambda x: F.to_mont(x, F.FP), (1,), 1,
-                              max_bucket=8192)
+                              max_bucket=8192, name="to_mont_p")
 
 
 def gt_order_ok(a) -> bool:
@@ -423,7 +535,7 @@ def gt_reduce_prod(x):
 
 _build()
 
-__all__ = ["bucketed", "tree_reduce_add", "gt_reduce_prod",
+__all__ = ["bucketed", "BUCKETED_OPS", "tree_reduce_add", "gt_reduce_prod",
            "gt_membership_ok", "gt_order_ok", "g1_add",
            "g1_neg", "g1_scalar_mul", "g1_scalar_mul64", "g1_eq",
            "g1_normalize", "g2_scalar_mul", "g2_normalize", "fixed_base_mul",

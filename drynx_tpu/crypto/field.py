@@ -93,19 +93,22 @@ def to_int(limbs) -> "int | np.ndarray":
 
 # When set, limb loops are fully unrolled at trace time (bigger XLA graphs,
 # slow compiles, fastest TPU execution). Default: rolled lax.scan loops —
-# ~16x smaller graphs, which keeps CPU-test compile times sane.
+# ~16x smaller graphs, which keeps CPU-test compile times sane. Read at CALL
+# time by the thin non-jitted public wrappers below and passed into the
+# jitted entry points as a STATIC `unroll` argument, so flipping it (tests,
+# TPU runs) creates fresh programs instead of silently reusing stale traces.
 import os
 
 UNROLL = os.environ.get("DRYNX_FIELD_UNROLL", "0") == "1"
 
 
-def _carry_chain(cols, out_limbs):
+def _carry_chain(cols, out_limbs, unroll: bool = False):
     """Sequential carry propagation down a column array -> out_limbs limbs.
 
     cols: (..., K) uint32 with values < 2^31. Returns ((..., out_limbs), carry).
     """
     carry0 = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
-    if UNROLL:
+    if unroll:
         outs = []
         carry = carry0
         for k in range(out_limbs):
@@ -124,13 +127,13 @@ def _carry_chain(cols, out_limbs):
     return jnp.moveaxis(outs, 0, -1), carry
 
 
-def _sub_limbs(a, b):
+def _sub_limbs(a, b, unroll: bool = False):
     """a - b with borrow chain. Returns (diff_limbs, borrow in {0,1})."""
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (NUM_LIMBS,))
     b = jnp.broadcast_to(b, batch + (NUM_LIMBS,))
     borrow0 = jnp.zeros(batch, dtype=jnp.uint32)
-    if UNROLL:
+    if unroll:
         outs = []
         borrow = borrow0
         for k in range(NUM_LIMBS):
@@ -150,36 +153,43 @@ def _sub_limbs(a, b):
     return jnp.moveaxis(outs, 0, -1), borrow
 
 
-def _cond_sub_m(a, ctx: ModCtx):
+def _cond_sub_m(a, ctx: ModCtx, unroll: bool = False):
     """Return a - m if a >= m else a (a < 2m assumed, normalized limbs)."""
-    diff, borrow = _sub_limbs(a, ctx.m_limbs)
+    diff, borrow = _sub_limbs(a, ctx.m_limbs, unroll)
     return jnp.where((borrow == 0)[..., None], diff, a)
 
 
-@partial(jax.jit, static_argnames="ctx")
-def add(a, b, ctx: ModCtx = FP):
-    """(a + b) mod m; inputs normalized (< m)."""
+@partial(jax.jit, static_argnames=("ctx", "unroll"))
+def _add(a, b, ctx: ModCtx, unroll: bool):
     cols = a + b  # < 2^17 per limb
-    s, carry = _carry_chain(cols, NUM_LIMBS)
+    s, carry = _carry_chain(cols, NUM_LIMBS, unroll)
     # a+b < 2m < 2^257: one carry bit possible beyond limb 15. Since m has
     # 256 bits, if carry==1 the value >= 2^256 > m: subtract m once; the
     # borrow from _sub_limbs cancels against carry.
-    diff, borrow = _sub_limbs(s, ctx.m_limbs)
+    diff, borrow = _sub_limbs(s, ctx.m_limbs, unroll)
     use_diff = (borrow == 0) | (carry == 1)
     return jnp.where(use_diff[..., None], diff, s)
 
 
-@partial(jax.jit, static_argnames="ctx")
-def sub(a, b, ctx: ModCtx = FP):
-    """(a - b) mod m; inputs normalized."""
-    diff, borrow = _sub_limbs(a, b)
-    plus_m, _ = _carry_chain(diff + ctx.m_limbs, NUM_LIMBS)
+def add(a, b, ctx: ModCtx = FP):
+    """(a + b) mod m; inputs normalized (< m)."""
+    return _add(a, b, ctx, UNROLL)
+
+
+@partial(jax.jit, static_argnames=("ctx", "unroll"))
+def _sub(a, b, ctx: ModCtx, unroll: bool):
+    diff, borrow = _sub_limbs(a, b, unroll)
+    plus_m, _ = _carry_chain(diff + ctx.m_limbs, NUM_LIMBS, unroll)
     return jnp.where((borrow == 1)[..., None], plus_m, diff)
 
 
-@partial(jax.jit, static_argnames="ctx")
+def sub(a, b, ctx: ModCtx = FP):
+    """(a - b) mod m; inputs normalized."""
+    return _sub(a, b, ctx, UNROLL)
+
+
 def neg(a, ctx: ModCtx = FP):
-    return sub(jnp.zeros_like(a), a, ctx)
+    return _sub(jnp.zeros_like(a), a, ctx, UNROLL)
 
 
 @jax.jit
@@ -193,13 +203,8 @@ def eq(a, b):
     return jnp.all(a == b, axis=-1)
 
 
-@partial(jax.jit, static_argnames="ctx")
-def mont_mul(a, b, ctx: ModCtx = FP):
-    """Montgomery product a*b*R^-1 mod m. Inputs/outputs in Montgomery form.
-
-    Schoolbook 512-bit column product with lo/hi split accumulation, then 16
-    interleaved Montgomery reduction steps (unrolled; static offsets).
-    """
+@partial(jax.jit, static_argnames=("ctx", "unroll"))
+def _mont_mul(a, b, ctx: ModCtx, unroll: bool):
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (NUM_LIMBS,))
     b = jnp.broadcast_to(b, batch + (NUM_LIMBS,))
@@ -212,7 +217,7 @@ def mont_mul(a, b, ctx: ModCtx = FP):
     m_limbs = ctx.m_limbs
     nprime = jnp.uint32(ctx.nprime)
 
-    if UNROLL:
+    if unroll:
         for i in range(NUM_LIMBS):
             cols = cols.at[..., i:i + NUM_LIMBS].add(lo[..., i, :])
             cols = cols.at[..., i + 1:i + 1 + NUM_LIMBS].add(hi[..., i, :])
@@ -267,60 +272,86 @@ def mont_mul(a, b, ctx: ModCtx = FP):
     # Result = cols[16..32] + reduction carry folded into column 16; value is
     # < 2m (standard Montgomery bound), so one conditional subtract suffices.
     cols_hi = cols[..., NUM_LIMBS:].at[..., 0].add(carry)
-    res, topcarry = _carry_chain(cols_hi[..., :NUM_LIMBS], NUM_LIMBS)
+    res, topcarry = _carry_chain(cols_hi[..., :NUM_LIMBS], NUM_LIMBS, unroll)
     top = cols_hi[..., NUM_LIMBS] + topcarry  # 0 or 1 (value < 2m < 2^257)
-    diff, borrow = _sub_limbs(res, m_limbs)
+    diff, borrow = _sub_limbs(res, m_limbs, unroll)
     use_diff = (borrow == 0) | (top > 0)
     return jnp.where(use_diff[..., None], diff, res)
 
 
-@partial(jax.jit, static_argnames="ctx")
+def mont_mul(a, b, ctx: ModCtx = FP):
+    """Montgomery product a*b*R^-1 mod m. Inputs/outputs in Montgomery form.
+
+    Schoolbook 512-bit column product with lo/hi split accumulation, then 16
+    interleaved Montgomery reduction steps (static offsets; unrolled or
+    scanned per the call-time UNROLL flag).
+    """
+    return _mont_mul(a, b, ctx, UNROLL)
+
+
 def mont_sqr(a, ctx: ModCtx = FP):
-    return mont_mul(a, a, ctx)
+    return _mont_mul(a, a, ctx, UNROLL)
 
 
-@partial(jax.jit, static_argnames="ctx")
 def to_mont(a, ctx: ModCtx = FP):
-    return mont_mul(a, ctx.r2_limbs, ctx)
+    return _mont_mul(a, ctx.r2_limbs, ctx, UNROLL)
 
 
-@partial(jax.jit, static_argnames="ctx")
 def from_mont(a, ctx: ModCtx = FP):
     one = jnp.zeros((NUM_LIMBS,), dtype=jnp.uint32).at[0].set(1)
-    return mont_mul(a, one, ctx)
+    return _mont_mul(a, one, ctx, UNROLL)
 
 
 def _exp_bits(e: int, nbits: int) -> np.ndarray:
     return np.asarray([(e >> i) & 1 for i in range(nbits)], dtype=np.uint32)
 
 
-@partial(jax.jit, static_argnames=("e", "ctx", "nbits"))
-def pow_const(a, e: int, ctx: ModCtx = FP, nbits: int = 256):
-    """a^e mod m for a STATIC exponent e, via right-to-left scan over bits.
-
-    a in Montgomery form; result in Montgomery form.
-    """
+@partial(jax.jit, static_argnames=("e", "ctx", "nbits", "unroll"))
+def _pow_const(a, e: int, ctx: ModCtx, nbits: int, unroll: bool):
     bits = jnp.asarray(_exp_bits(e, nbits), dtype=jnp.uint32)
     one = jnp.broadcast_to(ctx.one_mont, a.shape)
 
     def step(state, bit):
         acc, base = state
-        acc2 = mont_mul(acc, base, ctx)
+        acc2 = _mont_mul(acc, base, ctx, unroll)
         acc = jnp.where(bit == 1, acc2, acc)  # scalar cond broadcasts
-        base = mont_sqr(base, ctx)
+        base = _mont_mul(base, base, ctx, unroll)
         return (acc, base), None
 
     (acc, _), _ = jax.lax.scan(step, (one, a), bits)
     return acc
 
 
-@partial(jax.jit, static_argnames="ctx")
+def pow_const(a, e: int, ctx: ModCtx = FP, nbits: int = 256):
+    """a^e mod m for a STATIC exponent e, via right-to-left scan over bits.
+
+    a in Montgomery form; result in Montgomery form.
+    """
+    return _pow_const(a, e, ctx, nbits, UNROLL)
+
+
 def inv(a, ctx: ModCtx = FP):
     """a^(m-2) mod m (Fermat). a in Montgomery form. inv(0) = 0."""
-    return pow_const(a, ctx.modulus - 2, ctx)
+    return _pow_const(a, ctx.modulus - 2, ctx, 256, UNROLL)
 
 
-@partial(jax.jit, static_argnames="ctx")
+@partial(jax.jit, static_argnames=("ctx", "unroll"))
+def _batch_inv(a, ctx: ModCtx, unroll: bool):
+    shape = a.shape
+    flat = a.reshape((-1, NUM_LIMBS))
+    if flat.shape[0] == 0:
+        return a
+    mm = partial(_mont_mul, ctx=ctx, unroll=unroll)
+    pref = jax.lax.associative_scan(mm, flat)
+    suff = jax.lax.associative_scan(mm, flat, reverse=True)
+    total_inv = _pow_const(pref[-1], ctx.modulus - 2, ctx, 256, unroll)
+    one = jnp.broadcast_to(ctx.one_mont, (1, NUM_LIMBS))
+    left = jnp.concatenate([one, pref[:-1]], axis=0)
+    right = jnp.concatenate([suff[1:], one], axis=0)
+    out = mm(mm(left, right), total_inv)
+    return out.reshape(shape)
+
+
 def batch_inv(a, ctx: ModCtx = FP):
     """Montgomery batch inversion: ONE Fermat inversion + O(n) products for
     the whole batch (all leading dims). Inputs in Montgomery form, must be
@@ -330,22 +361,17 @@ def batch_inv(a, ctx: ModCtx = FP):
     prefix/suffix products via associative_scan (log-depth), then
     a_i^{-1} = P_{i-1} * S_{i+1} * (P_{n-1})^{-1}.
     """
-    shape = a.shape
-    flat = a.reshape((-1, NUM_LIMBS))
-    if flat.shape[0] == 0:
-        return a
-    mm = partial(mont_mul, ctx=ctx)
-    pref = jax.lax.associative_scan(mm, flat)
-    suff = jax.lax.associative_scan(mm, flat, reverse=True)
-    total_inv = inv(pref[-1], ctx)
-    one = jnp.broadcast_to(ctx.one_mont, (1, NUM_LIMBS))
-    left = jnp.concatenate([one, pref[:-1]], axis=0)
-    right = jnp.concatenate([suff[1:], one], axis=0)
-    out = mm(mm(left, right), total_inv)
-    return out.reshape(shape)
+    return _batch_inv(a, ctx, UNROLL)
 
 
-@partial(jax.jit, static_argnames="ctx")
+@partial(jax.jit, static_argnames=("ctx", "unroll"))
+def _reduce_512(hi, lo, ctx: ModCtx, unroll: bool):
+    hi_part = _mont_mul(hi, ctx.r2_limbs, ctx, unroll)
+    # mont_mul(hi, R2) = hi*R2*R^-1 = hi*R mod m = hi*2^256 mod m. Correct.
+    lo_norm = _cond_sub_m(lo, ctx, unroll)
+    return _add(hi_part, lo_norm, ctx, unroll)
+
+
 def reduce_512(hi, lo, ctx: ModCtx = FP):
     """(hi*2^256 + lo) mod m, both 16-limb plain (non-Montgomery) values.
 
@@ -353,10 +379,7 @@ def reduce_512(hi, lo, ctx: ModCtx = FP):
     ~2^-256. hi*2^256 mod m = mont_mul(hi, R2) (since mont_mul multiplies by
     R^-1); then add (lo mod m).
     """
-    hi_part = mont_mul(hi, ctx.r2_limbs, ctx)  # = hi * R mod m... see below
-    # mont_mul(hi, R2) = hi*R2*R^-1 = hi*R mod m = hi*2^256 mod m. Correct.
-    lo_norm = _cond_sub_m(lo, ctx)
-    return add(hi_part, lo_norm, ctx)
+    return _reduce_512(hi, lo, ctx, UNROLL)
 
 
 __all__ = [

@@ -38,6 +38,10 @@ LANES = 128                      # batch tile width
 
 # DRYNX_PALLAS_INTERPRET=1 runs the kernels through the Pallas interpreter
 # (any backend) — used by the CPU test suite to cover the kernel code paths.
+# The flag is read at CALL time by the thin non-jitted public wrappers and
+# passed into the jitted entry points as a STATIC argument, so flipping it
+# (tests monkeypatch the module global) keys a fresh trace instead of
+# leaking a stale interpret-mode executable out of the jit cache.
 INTERPRET = os.environ.get("DRYNX_PALLAS_INTERPRET", "0") == "1"
 
 # jax.enable_x64 exists as a top-level context manager only on some jax
@@ -279,11 +283,8 @@ def _scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref,
     o_ref[2] = acc[2]
 
 
-@functools.partial(jax.jit, static_argnames="n_windows")
-def scalar_mul_flat(p, k, n_windows: int = 64):
-    """k*P batched: p (N, 3, 16) Jacobian Montgomery, k (N, 16) plain
-    scalars -> (N, 3, 16). Pads N up to a LANES multiple and tiles.
-    n_windows < 64 truncates the ladder for short scalars (k < 16^W)."""
+@functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
+def _scalar_mul_flat(p, k, n_windows: int, interpret: bool):
     N = p.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -296,11 +297,19 @@ def scalar_mul_flat(p, k, n_windows: int = 64):
     # Mosaic cannot legalize; every value here is uint32, so drop to x32
     with enable_x64(False):
         out = _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np,
-                                 n_windows)
+                                 n_windows, interpret)
     return jnp.transpose(out, (2, 0, 1))[:N]
 
 
-def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np, n_windows=64):
+def scalar_mul_flat(p, k, n_windows: int = 64):
+    """k*P batched: p (N, 3, 16) Jacobian Montgomery, k (N, 16) plain
+    scalars -> (N, 3, 16). Pads N up to a LANES multiple and tiles.
+    n_windows < 64 truncates the ladder for short scalars (k < 16^W)."""
+    return _scalar_mul_flat(p, k, n_windows, INTERPRET)
+
+
+def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np, n_windows=64,
+                       interpret=False):
     return pl.pallas_call(
         functools.partial(_scalar_mul_kernel, n_windows=n_windows),
         grid=(n_tiles,),
@@ -318,7 +327,7 @@ def _pallas_scalar_mul(m_in, np_in, pt, kt, n_tiles, Np, n_windows=64):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((3, NL, Np), jnp.uint32),
         scratch_shapes=[pltpu.VMEM((n_windows, LANES), jnp.uint32)],
-        interpret=INTERPRET,
+        interpret=interpret,
     )(m_in, np_in, pt, kt)
 
 
@@ -369,11 +378,8 @@ def _fixed_base_kernel(m_ref, np_ref, tab_ref, k_ref, o_ref, dig_ref):
     o_ref[2] = acc[2]
 
 
-@functools.partial(jax.jit, static_argnames="n_windows")
-def fixed_base_mul_flat(table, k, n_windows: int = 64):
-    """k*P via a shared fixed-base window table. table: (64, 16, 3, 16) as
-    built by elgamal.FixedBase; k: (N, 16) plain scalars -> (N, 3, 16).
-    n_windows < 64 truncates the ladder for small scalars (k < 16^W)."""
+@functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
+def _fixed_base_mul_flat(table, k, n_windows: int, interpret: bool):
     N = k.shape[0]
     W = n_windows
     n_tiles = max((N + LANES - 1) // LANES, 1)
@@ -402,9 +408,16 @@ def fixed_base_mul_flat(table, k, n_windows: int = 64):
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((3, NL, Np), jnp.uint32),
             scratch_shapes=[pltpu.VMEM((W, LANES), jnp.uint32)],
-            interpret=INTERPRET,
+            interpret=interpret,
         )(m_in, np_in, tt, kt)
     return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+def fixed_base_mul_flat(table, k, n_windows: int = 64):
+    """k*P via a shared fixed-base window table. table: (64, 16, 3, 16) as
+    built by elgamal.FixedBase; k: (N, 16) plain scalars -> (N, 3, 16).
+    n_windows < 64 truncates the ladder for small scalars (k < 16^W)."""
+    return _fixed_base_mul_flat(table, k, n_windows, INTERPRET)
 
 
 # ---------------------------------------------------------------------------
@@ -457,9 +470,8 @@ def _pad_lanes(x, Np):
     return jnp.pad(x, pad, constant_values=np.zeros((), x.dtype))
 
 
-@jax.jit
-def point_add_flat(p, q):
-    """Complete add, (N, 3, 16) x (N, 3, 16) -> (N, 3, 16)."""
+@functools.partial(jax.jit, static_argnames="interpret")
+def _point_add_flat(p, q, interpret: bool):
     N = p.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -474,14 +486,17 @@ def point_add_flat(p, q):
                      memory_space=pltpu.VMEM),
     ])
     with enable_x64(False):
-        out = pl.pallas_call(_point_add_kernel, interpret=INTERPRET, **io)(m_in, np_in, pt, qt)
+        out = pl.pallas_call(_point_add_kernel, interpret=interpret, **io)(m_in, np_in, pt, qt)
     return jnp.transpose(out, (2, 0, 1))[:N]
 
 
-@jax.jit
-def point_reduce_flat(pts):
-    """Group-add reduce over axis 0: (R, N, 3, 16) -> (N, 3, 16), one
-    kernel call (replaces log2(R) jnp tree-reduce rounds)."""
+def point_add_flat(p, q):
+    """Complete add, (N, 3, 16) x (N, 3, 16) -> (N, 3, 16)."""
+    return _point_add_flat(p, q, INTERPRET)
+
+
+@functools.partial(jax.jit, static_argnames="interpret")
+def _point_reduce_flat(pts, interpret: bool):
     R, N = pts.shape[0], pts.shape[1]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -493,8 +508,14 @@ def point_reduce_flat(pts):
                      memory_space=pltpu.VMEM),
     ])
     with enable_x64(False):
-        out = pl.pallas_call(_point_reduce_kernel, interpret=INTERPRET, **io)(m_in, np_in, pt)
+        out = pl.pallas_call(_point_reduce_kernel, interpret=interpret, **io)(m_in, np_in, pt)
     return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+def point_reduce_flat(pts):
+    """Group-add reduce over axis 0: (R, N, 3, 16) -> (N, 3, 16), one
+    kernel call (replaces log2(R) jnp tree-reduce rounds)."""
+    return _point_reduce_flat(pts, INTERPRET)
 
 
 def available() -> bool:

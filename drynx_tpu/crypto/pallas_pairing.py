@@ -44,14 +44,41 @@ _PM2_BITS = [int(b) for b in bin(params.P - 2)[2:]]       # MSB-first
 
 
 def _fermat_inv(x, mul):
-    """x^(p-2) via the static square-and-multiply chain — the ONE copy all
-    inversion kernels share (Fp inversion inside Fp2/Fp6/Fp12 towers)."""
+    """x^(p-2) via the static square-and-multiply chain — trace-time
+    UNROLLED (~383 inlined Montgomery muls). Kept only as the fallback for
+    make_fp12 callers that pass no bit rows; the inversion kernels use
+    _fermat_inv_rolled, whose jaxpr is two muls in a fori_loop body."""
     acc = x
     for bit in _PM2_BITS[1:]:
         acc = mul(acc, acc)
         if bit:
             acc = mul(acc, x)
     return acc
+
+
+def _fermat_inv_rolled(x, mul, bits_ref):
+    """x^(p-2) as a fori_loop over pre-staged bit rows of p-2 (MSB-first,
+    bits_ref (256, B) — broadcast host-side like the Miller ate bits;
+    in-kernel constant broadcasts hit the unimplemented Mosaic
+    sublane+lane path). Square-and-multiply-ALWAYS with a per-lane select:
+    ~127 extra Fp muls per chain, but the traced body is 2 muls instead of
+    ~383 — the unrolled chain was the dominant jaxpr cost of every
+    inversion kernel (and the r05 C-stack overflow food)."""
+    def body(w, acc):
+        acc = mul(acc, acc)
+        bit = bits_ref[pl.ds(w, 1), :][0]        # (B,)
+        accm = mul(acc, x)
+        return jnp.where((bit == 1)[None, :], accm, acc)
+
+    return jax.lax.fori_loop(jnp.int32(1), jnp.int32(len(_PM2_BITS)),
+                             body, x)
+
+
+def _pm2_bits_tiles() -> np.ndarray:
+    """(256, LANES) uint32: MSB-first bits of p-2, lane-broadcast."""
+    return np.broadcast_to(
+        np.asarray(_PM2_BITS, dtype=np.uint32)[:, None],
+        (len(_PM2_BITS), LANES)).copy()
 
 
 # ---------------------------------------------------------------------------
@@ -104,8 +131,13 @@ def make_fp2(m, nprime):
                 fp_mul=mul, fp_add=add, fp_sub=sub)
 
 
-def make_fp12(F2):
+def make_fp12(F2, pm2_bits_ref=None):
     """Fp12 = 6-list of Fp2 pairs; flat tower w^6 = XI (crypto/fp12.py).
+
+    pm2_bits_ref: optional (256, B) bit rows of p-2 (see _pm2_bits_tiles).
+    When given, the Fermat Fp inversion inside the tower runs as a rolled
+    fori_loop (tiny jaxpr); without it the unrolled chain is used — only
+    the inversion kernel actually reaches fp_inv, and it passes the rows.
 
     Multiplication runs over the Fp6 sub-tower (v = w^2, v^3 = XI;
     f = A(v) + w*B(v) with A = (f0,f2,f4), B = (f1,f3,f5)):
@@ -198,6 +230,8 @@ def make_fp12(F2):
         return (F2["mul_xi"](a[2]), a[0], a[1])
 
     def fp_inv(x):
+        if pm2_bits_ref is not None:
+            return _fermat_inv_rolled(x, F2["fp_mul"], pm2_bits_ref)
         return _fermat_inv(x, F2["fp_mul"])
 
     def f2inv(a):
@@ -414,13 +448,8 @@ def _twist_frob_tiles() -> np.ndarray:
     return np.stack(cols, axis=-1)
 
 
-@jax.jit
-def miller_flat(px, py, qx, qy):
-    """Batched ate Miller function.
-
-    px, py: (N, 16) Fp Montgomery; qx, qy: (N, 2, 16) Fp2 Montgomery.
-    Returns (N, 6, 2, 16) unreduced Miller value (host layout).
-    """
+@functools.partial(jax.jit, static_argnames="interpret")
+def _miller_flat(px, py, qx, qy, interpret: bool):
     from . import fp2 as F2j
 
     N = px.shape[0]
@@ -463,9 +492,18 @@ def miller_flat(px, py, qx, qy):
             out_specs=pl.BlockSpec((12, NL, LANES), lambda i: (0, 0, i),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((12, NL, Np), jnp.uint32),
-            interpret=INTERPRET,
+            interpret=interpret,
         )(m_in, np_in, g_in, bits_in, p_in, q_in)
     return jnp.transpose(out, (2, 0, 1))[:N].reshape(N, 6, 2, NL)
+
+
+def miller_flat(px, py, qx, qy):
+    """Batched ate Miller function.
+
+    px, py: (N, 16) Fp Montgomery; qx, qy: (N, 2, 16) Fp2 Montgomery.
+    Returns (N, 6, 2, 16) unreduced Miller value (host layout).
+    """
+    return _miller_flat(px, py, qx, qy, INTERPRET)
 
 
 _TF_JNP = None
@@ -494,9 +532,9 @@ def _f12_mul_kernel(m_ref, np_ref, a_ref, b_ref, o_ref):
     _f12_store(o_ref, F12["mul"](_f12_load(a_ref), _f12_load(b_ref)))
 
 
-def _f12_inv_kernel(m_ref, np_ref, a_ref, o_ref):
+def _f12_inv_kernel(m_ref, np_ref, bits_ref, a_ref, o_ref):
     F2 = make_fp2(m_ref[:], np_ref[0, 0])
-    F12 = make_fp12(F2)
+    F12 = make_fp12(F2, pm2_bits_ref=bits_ref)
     _f12_store(o_ref, F12["inv"](_f12_load(a_ref)))
 
 
@@ -651,10 +689,8 @@ def _frob_tiles(which) -> np.ndarray:
     return _FROB_TILES[which]
 
 
-@functools.partial(jax.jit, static_argnames="which")
-def f12_slotmul_flat(a, which: str):
-    """Frobenius^e / conj6 on (N, 6, 2, 16): which in
-    {frob1, frob2, frob3, conj6}."""
+@functools.partial(jax.jit, static_argnames=("which", "interpret"))
+def _f12_slotmul_flat(a, which: str, interpret: bool):
     N = a.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -668,8 +704,14 @@ def f12_slotmul_flat(a, which: str):
     with enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_f12_slotmul_kernel, conj_fp2=conj_fp2),
-            interpret=INTERPRET, **io)(m_in, np_in, c_in, _to_tiles(a, Np))
+            interpret=interpret, **io)(m_in, np_in, c_in, _to_tiles(a, Np))
     return _from_tiles(out, N)
+
+
+def f12_slotmul_flat(a, which: str):
+    """Frobenius^e / conj6 on (N, 6, 2, 16): which in
+    {frob1, frob2, frob3, conj6}."""
+    return _f12_slotmul_flat(a, which, INTERPRET)
 
 
 def _f12_io(n_tiles, Np, n_inputs):
@@ -703,37 +745,47 @@ def _mnp():
             jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32))
 
 
-@jax.jit
-def f12_mul_flat(a, b):
-    """(N, 6, 2, 16) x (N, 6, 2, 16) -> (N, 6, 2, 16)."""
+@functools.partial(jax.jit, static_argnames="interpret")
+def _f12_mul_flat(a, b, interpret: bool):
     N = a.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
     with enable_x64(False):
-        out = pl.pallas_call(_f12_mul_kernel, interpret=INTERPRET,
+        out = pl.pallas_call(_f12_mul_kernel, interpret=interpret,
                              **_f12_io(n_tiles, Np, 2))(
             m_in, np_in, _to_tiles(a, Np), _to_tiles(b, Np))
     return _from_tiles(out, N)
 
 
-@jax.jit
-def f12_inv_flat(a):
+def f12_mul_flat(a, b):
+    """(N, 6, 2, 16) x (N, 6, 2, 16) -> (N, 6, 2, 16)."""
+    return _f12_mul_flat(a, b, INTERPRET)
+
+
+@functools.partial(jax.jit, static_argnames="interpret")
+def _f12_inv_flat(a, interpret: bool):
     N = a.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
+    bits_in = jnp.asarray(_pm2_bits_tiles(), dtype=jnp.uint32)
+    io = _f12_io(n_tiles, Np, 1)
+    io["in_specs"].insert(2, pl.BlockSpec(
+        (len(_PM2_BITS), LANES), lambda i: (0, 0),
+        memory_space=pltpu.VMEM))
     with enable_x64(False):
-        out = pl.pallas_call(_f12_inv_kernel, interpret=INTERPRET,
-                             **_f12_io(n_tiles, Np, 1))(
-            m_in, np_in, _to_tiles(a, Np))
+        out = pl.pallas_call(_f12_inv_kernel, interpret=interpret, **io)(
+            m_in, np_in, bits_in, _to_tiles(a, Np))
     return _from_tiles(out, N)
 
 
-@functools.partial(jax.jit, static_argnames="n_bits")
-def f12_pow_flat(f, k, n_bits: int = 256):
-    """f^k batched: f (N, 6, 2, 16), k (N, 16) plain limbs (LSB-first bits;
-    n_bits < 256 truncates for exponents known to be short, e.g. |u| = 63)."""
+def f12_inv_flat(a):
+    return _f12_inv_flat(a, INTERPRET)
+
+
+@functools.partial(jax.jit, static_argnames=("n_bits", "interpret"))
+def _f12_pow_flat(f, k, n_bits: int, interpret: bool):
     N = f.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -751,16 +803,21 @@ def f12_pow_flat(f, k, n_bits: int = 256):
         out = pl.pallas_call(
             functools.partial(_f12_pow_kernel, n_bits=n_bits),
             scratch_shapes=[pltpu.VMEM((n_bits, LANES), jnp.uint32)],
-            interpret=INTERPRET, **io)(
+            interpret=interpret, **io)(
             m_in, np_in, one_in, _to_tiles(f, Np), kt)
     return _from_tiles(out, N)
 
 
-@functools.partial(jax.jit, static_argnames=("n_bits", "wbits", "cyc"))
-def f12_wpow_flat(f, k, n_bits: int = 256, wbits: int = 3,
-                  cyc: bool = False):
-    """Windowed f^k batched: f (N, 6, 2, 16), k (N, 16) plain limbs.
-    cyc=True uses cyclotomic squarings (requires f ∈ GΦ12 — see kernel)."""
+def f12_pow_flat(f, k, n_bits: int = 256):
+    """f^k batched: f (N, 6, 2, 16), k (N, 16) plain limbs (LSB-first bits;
+    n_bits < 256 truncates for exponents known to be short, e.g. |u| = 63)."""
+    return _f12_pow_flat(f, k, n_bits, INTERPRET)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_bits", "wbits", "cyc", "interpret"))
+def _f12_wpow_flat(f, k, n_bits: int, wbits: int, cyc: bool,
+                   interpret: bool):
     N = f.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -779,9 +836,16 @@ def f12_wpow_flat(f, k, n_bits: int = 256, wbits: int = 3,
             functools.partial(_f12_wpow_kernel, n_bits=n_bits, wbits=wbits,
                               cyc=cyc),
             scratch_shapes=[pltpu.VMEM((n_win, LANES), jnp.uint32)],
-            interpret=INTERPRET, **io)(
+            interpret=interpret, **io)(
             m_in, np_in, one_in, _to_tiles(f, Np), kt)
     return _from_tiles(out, N)
+
+
+def f12_wpow_flat(f, k, n_bits: int = 256, wbits: int = 3,
+                  cyc: bool = False):
+    """Windowed f^k batched: f (N, 6, 2, 16), k (N, 16) plain limbs.
+    cyc=True uses cyclotomic squarings (requires f ∈ GΦ12 — see kernel)."""
+    return _f12_wpow_flat(f, k, n_bits, wbits, cyc, INTERPRET)
 
 
 def _f12_csqr_kernel(m_ref, np_ref, a_ref, o_ref):
@@ -790,24 +854,27 @@ def _f12_csqr_kernel(m_ref, np_ref, a_ref, o_ref):
     _f12_store(o_ref, F12["csqr"](_f12_load(a_ref)))
 
 
-@jax.jit
-def f12_csqr_flat(a):
-    """Cyclotomic squaring, (N, 6, 2, 16) -> (N, 6, 2, 16). Input MUST be
-    in GΦ12 (pairing outputs after final exp are)."""
+@functools.partial(jax.jit, static_argnames="interpret")
+def _f12_csqr_flat(a, interpret: bool):
     N = a.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
     with enable_x64(False):
-        out = pl.pallas_call(_f12_csqr_kernel, interpret=INTERPRET,
+        out = pl.pallas_call(_f12_csqr_kernel, interpret=interpret,
                              **_f12_io(n_tiles, Np, 1))(
             m_in, np_in, _to_tiles(a, Np))
     return _from_tiles(out, N)
 
 
-@jax.jit
-def f12_mulreduce8_flat(g):
-    """(N, 8, 6, 2, 16) -> (N, 6, 2, 16): per-row product of 8 values."""
+def f12_csqr_flat(a):
+    """Cyclotomic squaring, (N, 6, 2, 16) -> (N, 6, 2, 16). Input MUST be
+    in GΦ12 (pairing outputs after final exp are)."""
+    return _f12_csqr_flat(a, INTERPRET)
+
+
+@functools.partial(jax.jit, static_argnames="interpret")
+def _f12_mulreduce8_flat(g, interpret: bool):
     N = g.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -818,9 +885,14 @@ def f12_mulreduce8_flat(g):
                                        lambda i: (0, 0, 0, i),
                                        memory_space=pltpu.VMEM))
     with enable_x64(False):
-        out = pl.pallas_call(_f12_mulreduce8_kernel, interpret=INTERPRET,
+        out = pl.pallas_call(_f12_mulreduce8_kernel, interpret=interpret,
                              **io)(m_in, np_in, gt)
     return _from_tiles(out, N)
+
+
+def f12_mulreduce8_flat(g):
+    """(N, 8, 6, 2, 16) -> (N, 6, 2, 16): per-row product of 8 values."""
+    return _f12_mulreduce8_flat(g, INTERPRET)
 
 
 def window_digits(k, n_win: int = 64):
@@ -874,18 +946,23 @@ def gt_pow_fixed_multi(tables, base_idx, k):
 # crawls on TPU) + G2 windowed scalar-mult ladder
 # ---------------------------------------------------------------------------
 
-def _fp_inv_kernel(m_ref, np_ref, x_ref, o_ref):
+def _fp_inv_kernel(m_ref, np_ref, bits_ref, x_ref, o_ref):
     F2 = make_fp2(m_ref[:], np_ref[0, 0])
-    o_ref[:] = _fermat_inv(x_ref[:], F2["fp_mul"])
+    o_ref[:] = _fermat_inv_rolled(x_ref[:], F2["fp_mul"], bits_ref)
 
 
-@jax.jit
-def fp_inv_flat(x):
-    """x^(p-2) batched: (N, 16) Montgomery -> (N, 16) Montgomery."""
+def _inv_bits_spec():
+    return pl.BlockSpec((len(_PM2_BITS), LANES), lambda i: (0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+@functools.partial(jax.jit, static_argnames="interpret")
+def _fp_inv_flat(x, interpret: bool):
     N = x.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
+    bits_in = jnp.asarray(_pm2_bits_tiles(), dtype=jnp.uint32)
     xt = _pad_lanes(jnp.transpose(x, (1, 0)), Np)
     with enable_x64(False):
         out = pl.pallas_call(
@@ -896,35 +973,41 @@ def fp_inv_flat(x):
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, 1), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
+                _inv_bits_spec(),
                 pl.BlockSpec((NL, LANES), lambda i: (0, i),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=pl.BlockSpec((NL, LANES), lambda i: (0, i),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((NL, Np), jnp.uint32),
-            interpret=INTERPRET,
-        )(m_in, np_in, xt)
+            interpret=interpret,
+        )(m_in, np_in, bits_in, xt)
     return jnp.transpose(out, (1, 0))[:N]
 
 
-def _f2_inv_kernel(m_ref, np_ref, a_ref, o_ref):
+def fp_inv_flat(x):
+    """x^(p-2) batched: (N, 16) Montgomery -> (N, 16) Montgomery."""
+    return _fp_inv_flat(x, INTERPRET)
+
+
+def _f2_inv_kernel(m_ref, np_ref, bits_ref, a_ref, o_ref):
     F2 = make_fp2(m_ref[:], np_ref[0, 0])
     a = (a_ref[0], a_ref[1])
     # norm = a0^2 + a1^2; inv via Fermat; out = (a0*ni, -a1*ni)
     n = F2["fp_add"](F2["fp_mul"](a[0], a[0]), F2["fp_mul"](a[1], a[1]))
-    acc = _fermat_inv(n, F2["fp_mul"])
+    acc = _fermat_inv_rolled(n, F2["fp_mul"], bits_ref)
     z = jnp.zeros_like(a[1])
     o_ref[0] = F2["fp_mul"](a[0], acc)
     o_ref[1] = F2["fp_mul"](F2["fp_sub"](z, a[1]), acc)
 
 
-@jax.jit
-def f2_inv_flat(a):
-    """Fp2 inverse batched: (N, 2, 16) Montgomery -> (N, 2, 16)."""
+@functools.partial(jax.jit, static_argnames="interpret")
+def _f2_inv_flat(a, interpret: bool):
     N = a.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     m_in, np_in = _mnp()
+    bits_in = jnp.asarray(_pm2_bits_tiles(), dtype=jnp.uint32)
     at = _pad_lanes(jnp.transpose(a, (1, 2, 0)), Np)
     with enable_x64(False):
         out = pl.pallas_call(
@@ -935,15 +1018,21 @@ def f2_inv_flat(a):
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, 1), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
+                _inv_bits_spec(),
                 pl.BlockSpec((2, NL, LANES), lambda i: (0, 0, i),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=pl.BlockSpec((2, NL, LANES), lambda i: (0, 0, i),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((2, NL, Np), jnp.uint32),
-            interpret=INTERPRET,
-        )(m_in, np_in, at)
+            interpret=interpret,
+        )(m_in, np_in, bits_in, at)
     return jnp.transpose(out, (2, 0, 1))[:N]
+
+
+def f2_inv_flat(a):
+    """Fp2 inverse batched: (N, 2, 16) Montgomery -> (N, 2, 16)."""
+    return _f2_inv_flat(a, INTERPRET)
 
 
 def _f2_is_zero(a):
@@ -1066,10 +1155,8 @@ def _g2_scalar_mul_kernel(m_ref, np_ref, p_ref, k_ref, o_ref, dig_ref):
     o_ref[4], o_ref[5] = acc[2]
 
 
-@jax.jit
-def g2_scalar_mul_flat(p, k):
-    """k*Q batched: p (N, 3, 2, 16) Jacobian Montgomery, k (N, 16) plain
-    scalars -> (N, 3, 2, 16)."""
+@functools.partial(jax.jit, static_argnames="interpret")
+def _g2_scalar_mul_flat(p, k, interpret: bool):
     N = p.shape[0]
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
@@ -1094,9 +1181,15 @@ def g2_scalar_mul_flat(p, k):
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((6, NL, Np), jnp.uint32),
             scratch_shapes=[pltpu.VMEM((64, LANES), jnp.uint32)],
-            interpret=INTERPRET,
+            interpret=interpret,
         )(m_in, np_in, pt, kt)
     return jnp.transpose(out, (2, 0, 1))[:N].reshape(N, 3, 2, NL)
+
+
+def g2_scalar_mul_flat(p, k):
+    """k*Q batched: p (N, 3, 2, 16) Jacobian Montgomery, k (N, 16) plain
+    scalars -> (N, 3, 2, 16)."""
+    return _g2_scalar_mul_flat(p, k, INTERPRET)
 
 
 # ---------------------------------------------------------------------------

@@ -189,7 +189,8 @@ def _gt_pow_multi(tables, base_idx, k):
     global _GT_POW_MULTI
     if _GT_POW_MULTI is None:
         _GT_POW_MULTI = B.bucketed(pp.gt_pow_fixed_multi, (-1, 0, 1), 3,
-                                   min_bucket=32, max_bucket=2048)
+                                   min_bucket=32, max_bucket=2048,
+                                   name="gt_pow_fixed_multi")
     return _GT_POW_MULTI(tables, base_idx, k)
 
 
@@ -424,8 +425,51 @@ def gt_pow_gtb(k):
         tab = gt_base_table()
         _GT_POW_GTB = B.bucketed(
             lambda kk: pp.gt_pow_fixed(tab, kk), (1,), 3, min_bucket=32,
-            max_bucket=2048)
+            max_bucket=2048, name="gt_pow_gtb")
     return _GT_POW_GTB(k)
+
+
+def aot_register_bucketed(build_gtb_table: bool = False) -> None:
+    """Force-build the LAZY bucketed wrappers so BUCKETED_OPS enumerates
+    them (the precompile registry, drynx_tpu/compilecache). Both wrappers
+    are memoized module globals, so the runtime paths above reuse the
+    exact objects registered here — no duplicate traces.
+
+    build_gtb_table: also build gt_pow_gtb, whose closure captures the
+    gtB window table (a ~1.2k-mul HOST build) — only worth paying when
+    the Pallas path will actually dispatch it (it is TPU-only)."""
+    from ..crypto import batching as B
+    from ..crypto import pallas_pairing as pp
+
+    global _GT_POW_MULTI, _GT_POW_GTB
+    if _GT_POW_MULTI is None:
+        _GT_POW_MULTI = B.bucketed(pp.gt_pow_fixed_multi, (-1, 0, 1), 3,
+                                   min_bucket=32, max_bucket=2048,
+                                   name="gt_pow_fixed_multi")
+    if build_gtb_table and _GT_POW_GTB is None:
+        tab = gt_base_table()
+        _GT_POW_GTB = B.bucketed(
+            lambda kk: pp.gt_pow_fixed(tab, kk), (1,), 3, min_bucket=32,
+            max_bucket=2048, name="gt_pow_gtb")
+
+
+def prewarm_sig_tables(sigs: list["RangeSig"],
+                       pow_tables: bool | None = None) -> None:
+    """Build the per-signature GT tables OUTSIDE the timed survey path.
+
+    sig_gt_table (one pairing batch) and — on the Pallas path —
+    sig_gt_pow_tables (~10 s host build at ns=3, u=16) used to be built
+    lazily inside create_range_proofs, landing their one-time cost in the
+    middle of the timed proofs window. Both are LRU-cached by the A-table
+    digest, so calling this at signature setup (LocalCluster
+    ensure_range_sigs) makes the in-survey lookups pure cache hits."""
+    from ..crypto import pallas_ops as po
+
+    sig_gt_table(sigs)
+    if pow_tables is None:
+        pow_tables = po.available()
+    if pow_tables:
+        _sig_gt_pow_tables_dev(sigs)
 
 
 def _upow_mont(u: int, l: int) -> jnp.ndarray:

@@ -115,7 +115,14 @@ class LocalCluster:
 
     def __init__(self, n_cns: int = 3, n_dps: int = 5, n_vns: int = 3,
                  seed: int = 1, dlog_limit: int = 10000,
-                 link=None, share_verify_cache: bool = True):
+                 link=None, share_verify_cache: bool = True,
+                 precompile: str = "auto"):
+        # precompile: "auto" warms the proofs-on kernel set on the MAIN
+        # thread before the first proofs-on survey WHEN the Pallas backend
+        # is up (where _async_proof uses real threads — first-touch tracing
+        # on a worker thread is the r05 segfault class); "on" forces the
+        # warmup on any backend; "off" disables it (compilecache/registry).
+        assert precompile in ("auto", "on", "off"), precompile
         # link: an optional transport.LinkModel; when active, the in-process
         # cluster sleeps at every boundary where the reference pays a real
         # network message (DP ciphertext upload, proof delivery to each VN),
@@ -178,6 +185,13 @@ class LocalCluster:
         self.surveys: dict[str, Survey] = {}
         # serializes proof threads' device work (see _async_proof)
         self._proof_device_lock = threading.Lock()
+        self._aot_mode = precompile
+        self._aot_warmed = False
+        # recursion-limit + thread-stack-size guard BEFORE any proof
+        # thread exists (threading.stack_size only affects later threads)
+        from .. import compilecache as cc
+
+        cc.trace_guard()
 
     # ------------------------------------------------------------------
     # Proof payload verifiers installed at the VNs
@@ -326,6 +340,12 @@ class LocalCluster:
         if u not in self.range_sigs:
             self.range_sigs[u] = [rproof.init_range_sig(u, self.rng)
                                   for _ in self.cns]
+            # one-time GT tables (sig_gt_table; + the ~10 s host build of
+            # sig_gt_pow_tables on the Pallas path) built HERE, at
+            # signature setup, instead of lazily inside the first timed
+            # proof creation — both are LRU-cached by A-table digest, so
+            # in-survey lookups become pure cache hits
+            rproof.prewarm_sig_tables(self.range_sigs[u])
         return self.range_sigs[u]
 
     def prewarm_dro(self, noise_size: int, n_surveys: int = 1,
@@ -415,6 +435,42 @@ class LocalCluster:
         return list(q.ranges) * (q.n_groups() if q.group_by else 1)
 
     # ------------------------------------------------------------------
+    def _warm_kernels(self, tm: PhaseTimers, q) -> None:
+        """Main-thread warmup of the proofs-on program set (compilecache).
+
+        Dispatches every registered program once, serially, under
+        _proof_device_lock, BEFORE _async_proof / dp_lists threads start —
+        so proof worker threads only ever re-execute cached traces. This
+        eliminated the r05 segfault class: partial_eval tracing pair_flat
+        from a DP proof thread overflowed the thread's C stack
+        (service.py:500 dp_lists). Runs once per cluster; "auto" mode
+        limits it to the Pallas backend, where _async_proof actually uses
+        threads (on CPU the proof work runs inline on the main thread, so
+        lazy first-touch tracing is already main-thread-only)."""
+        from ..crypto import pallas_ops as po
+        from .. import compilecache as cc
+
+        if self._aot_warmed or self._aot_mode == "off":
+            return
+        if self._aot_mode == "auto" and not po.available():
+            return
+        ranges = self._ranges_per_value(q)
+        u0, l0 = ranges[0] if ranges else (16, 5)
+        profile = cc.Profile(
+            n_cns=len(self.cns), n_dps=len(self.dp_idents),
+            n_values=max(len(ranges), 1), u=int(u0) or 16,
+            l=int(l0) or 5, dlog_limit=self.dlog.limit)
+        with self._proof_device_lock:
+            cc.trace_guard()
+            before = cc.STATS.totals()
+            cc.precompile(profile, mode="execute",
+                          log=lambda m: log.lvl2(f"precompile: {m}"))
+            after = cc.STATS.totals()
+            tm.add("PrecompileTraceExec",
+                   after["lower_seconds"] - before["lower_seconds"])
+            self._aot_warmed = True
+
+    # ------------------------------------------------------------------
     # The full survey (reference SendSurveyQuery path, SURVEY.md §3.1)
     # ------------------------------------------------------------------
     def run_survey(self, sq: SurveyQuery, seed: int = 0):
@@ -462,6 +518,9 @@ class LocalCluster:
                  "obfuscation": sq.obfuscation_proof_threshold,
                  "keyswitch": sq.key_switching_proof_threshold},
                 expected_range=nbrs[0] - len(absent))
+            # first-touch tracing of the proofs-on kernel set happens HERE,
+            # on the main thread, before any proof worker thread exists
+            self._warm_kernels(tm, q)
 
         # --- DP phase: encode + encrypt (+ range proofs) ----------------
         tm.start("DataCollectionProtocol")

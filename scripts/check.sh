@@ -7,10 +7,14 @@ cd "$(dirname "$0")/.."
 echo "== static analysis (python -m drynx_tpu.analysis) =="
 python -m drynx_tpu.analysis drynx_tpu/ "$@"
 
+echo "== precompile registry smoke (trace+lower the proofs-on program set) =="
+JAX_PLATFORMS=cpu python -m drynx_tpu.precompile --dry-run --quiet
+
 echo "== quick tests =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     tests/test_static_analysis.py \
     tests/test_analysis_rules.py \
+    tests/test_precompile.py \
     tests/test_field.py \
     tests/test_refimpl.py \
     tests/test_batching.py \

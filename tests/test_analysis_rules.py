@@ -335,3 +335,113 @@ def test_baseline_matches_by_line_text_and_respects_count():
     un, matched, stale = apply_baseline(
         found, [entry(1, "z = jnp.zeros((9,))")])
     assert len(stale) == 1 and len(un) == 2
+
+
+# -- thread-trace -----------------------------------------------------------
+
+SERVICE_PATH = "drynx_tpu/service/synthetic.py"
+
+THREAD_JIT = """
+    import threading
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        return x + 1
+
+    def start():
+        def work():
+            return kernel(1)
+        threading.Thread(target=work).start()
+"""
+
+
+def test_thread_trace_fires_on_unlocked_jit_from_thread_target():
+    found = run(THREAD_JIT, relpath=SERVICE_PATH, rule="thread-trace")
+    assert len(found) == 1
+    assert "'work'" in found[0].message and "'kernel'" in found[0].message
+
+
+def test_thread_trace_fires_on_bucketed_bound_name():
+    src = """
+        import threading
+        from drynx_tpu.crypto import batching as B
+
+        op = B.bucketed(lambda x: x, (0,), 1)
+
+        def work():
+            op(1)
+
+        def start():
+            threading.Thread(target=work).start()
+    """
+    found = run(src, relpath=SERVICE_PATH, rule="thread-trace")
+    assert len(found) == 1 and "'op'" in found[0].message
+
+
+def test_thread_trace_fires_on_lambda_target():
+    src = """
+        import threading
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x
+
+        t = threading.Thread(target=lambda: kernel(1))
+    """
+    found = run(src, relpath=SERVICE_PATH, rule="thread-trace")
+    assert len(found) == 1 and "'kernel'" in found[0].message
+
+
+def test_thread_trace_quiet_under_compile_lock():
+    src = """
+        import threading
+        import jax
+
+        _compile_lock = threading.Lock()
+
+        @jax.jit
+        def kernel(x):
+            return x
+
+        def work():
+            with _compile_lock:
+                return kernel(1)
+
+        def start():
+            threading.Thread(target=work).start()
+    """
+    assert run(src, relpath=SERVICE_PATH, rule="thread-trace") == []
+
+
+def test_thread_trace_quiet_on_dynamic_target_and_plain_calls():
+    # `build` is a parameter (the service.py _async_proof shape): statically
+    # unresolvable, must not fire. Plain host functions must not fire either.
+    src = """
+        import threading
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x
+
+        def spawn(build):
+            def work():
+                return build()
+            threading.Thread(target=work).start()
+
+        def host_only():
+            return 2 + 2
+
+        def start():
+            threading.Thread(target=host_only).start()
+    """
+    assert run(src, relpath=SERVICE_PATH, rule="thread-trace") == []
+
+
+def test_thread_trace_suppressible_with_noqa():
+    src = THREAD_JIT.replace(
+        "threading.Thread(target=work).start()",
+        "threading.Thread(target=work).start()  # drynx: noqa[thread-trace]")
+    assert run(src, relpath=SERVICE_PATH, rule="thread-trace") == []

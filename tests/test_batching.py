@@ -85,3 +85,45 @@ def test_jnp_gt_tier_pulse(monkeypatch):
     eps = jnp.asarray(F12.from_ref(refimpl.gphi12_cofactor_element(13)))
     assert B.gt_membership_ok(eps[None])
     assert not B.gt_order_ok(eps[None])
+
+
+def test_bucketed_memoized_one_wrapper_per_config():
+    # same (fn, ranks, buckets) -> the SAME wrapper object from every call
+    # site, so each (op, bucket) program traces once per process
+    def fn(a, b):
+        return a + b
+
+    w1 = bucketed(fn, (1, 1), 1, min_bucket=8)
+    w2 = bucketed(fn, (1, 1), 1, min_bucket=8)
+    assert w1 is w2
+    # a different config is a different program set -> different wrapper
+    w3 = bucketed(fn, (1, 1), 1, min_bucket=16)
+    assert w3 is not w1
+
+
+def test_bucketed_memoized_wrapper_does_not_retrace():
+    from drynx_tpu.crypto import batching as B
+
+    def fn(a):
+        return a * 2
+
+    traces = []
+    old = B.TRACE_HOOK
+    B.TRACE_HOOK = lambda name: traces.append(name)
+    try:
+        w = bucketed(fn, (0,), 0, min_bucket=8)
+        a = jnp.arange(5, dtype=jnp.int32)
+        np.testing.assert_array_equal(np.asarray(w(a)),
+                                      2 * np.asarray(a))
+        n_first = len(traces)
+        assert n_first >= 1  # first call traced
+        # same shape through the memoized wrapper (fresh bucketed() call
+        # included): cached trace, hook must not fire again
+        w2 = bucketed(fn, (0,), 0, min_bucket=8)
+        assert w2 is w
+        np.testing.assert_array_equal(np.asarray(w2(a + 1)),
+                                      2 * (np.asarray(a) + 1))
+        np.testing.assert_array_equal(np.asarray(w(a)), 2 * np.asarray(a))
+        assert len(traces) == n_first
+    finally:
+        B.TRACE_HOOK = old

@@ -20,7 +20,6 @@ Reference analogue: kyber's arithmetic is exercised by every Go test; ours
 must not go a round with the compiled path unexecuted.
 """
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
@@ -38,13 +37,11 @@ RNG = np.random.default_rng(41)
 
 @pytest.fixture(autouse=True)
 def interpret_kernels(monkeypatch):
+    # INTERPRET is threaded through as a static arg / per-mode jit key
+    # (batching._trace_mode), so interpret-mode traces cannot leak into
+    # later tests — no cache-clearing teardown needed.
     monkeypatch.setattr(po, "INTERPRET", True)
     monkeypatch.setattr(pp, "INTERPRET", True)
-    yield
-    # INTERPRET is baked into the jit/pallas trace cache at trace time
-    # (keyed only on shapes/static args), so traces built here would leak
-    # interpret-mode kernels into later tests. Drop them on the way out.
-    jax.clear_caches()
 
 
 def _rfp() -> int:

@@ -10,7 +10,6 @@ reduced pairing against refimpl.pair.
 import os
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
@@ -37,13 +36,11 @@ RNG = np.random.default_rng(23)
 
 @pytest.fixture(autouse=True)
 def interpret_mode(monkeypatch):
+    # INTERPRET is threaded through as a static arg / per-mode jit key
+    # (batching._trace_mode), so interpret-mode traces cannot leak into
+    # later tests — no cache-clearing teardown needed.
     monkeypatch.setattr(po, "INTERPRET", True)
     monkeypatch.setattr(pp, "INTERPRET", True)
-    yield
-    # Traces cached while INTERPRET was patched would survive the
-    # monkeypatch undo (jit caches key on shapes, not globals); clear
-    # them so later tests recompile against the real setting.
-    jax.clear_caches()
 
 
 def rfp():

@@ -1,0 +1,109 @@
+"""Compilecache registry structure: the declared program set must cover
+every trace entry a proofs-on survey dispatches (BUCKETED_OPS + the raw
+Pallas flat kernels + the fused service jits). Trace-free by default —
+only the two driver smoke tests lower anything, and only the cheapest
+scalar-field programs."""
+import sys
+
+import pytest
+
+from drynx_tpu import compilecache as cc
+from drynx_tpu.compilecache.stats import CompileStats
+
+
+@pytest.fixture(scope="module")
+def registry():
+    return cc.build_registry(cc.BENCH)
+
+
+def test_registry_covers_every_bucketed_op(registry):
+    """Every named bucketed op (including the lazy range-proof wrappers
+    force-built by aot_register_bucketed) has at least one registered
+    program — a new `name=`d bucketed() call site without a registry
+    entry fails here."""
+    from drynx_tpu.crypto import batching as B
+
+    covered = {s.op for s in registry if s.kind == "bucketed"}
+    missing = set(B.BUCKETED_OPS) - covered
+    assert not missing, (
+        f"BUCKETED_OPS entries without a compilecache program: {missing} "
+        f"— add them to registry._B_SCHEMAS")
+    # the Pallas-only lazy wrappers are registered even when the current
+    # backend never builds them (they are skipped, not absent)
+    assert {"gt_pow_fixed_multi", "gt_pow_gtb"} <= covered
+
+
+def test_registry_covers_pallas_and_fused_families(registry):
+    ops = {(s.kind, s.op) for s in registry}
+    for op in ("miller_flat", "f12_wpow_flat", "f12_mulreduce8_flat"):
+        assert ("pallas", op) in ops
+    for op in ("enc", "agg", "ks", "dec"):
+        assert ("fused", op) in ops
+
+
+def test_registry_names_unique_and_thunks_wellformed(registry):
+    names = [s.name for s in registry]
+    assert len(names) == len(set(names))
+    for s in registry:
+        assert callable(s.lower) and callable(s.dispatched), s.name
+        assert s.call is None or callable(s.call), s.name
+        assert s.kind in ("bucketed", "pallas", "fused"), s.name
+
+
+def test_registry_scales_with_profile():
+    small = cc.Profile(n_cns=2, n_dps=2, n_values=2, u=4, l=2,
+                       dlog_limit=100)
+    specs = cc.build_registry(small)
+    # smaller survey -> smaller buckets -> at least as few programs, and
+    # every bucketed name stays within the wrapper's max_bucket cap
+    for s in specs:
+        if s.kind == "bucketed":
+            bucket = int(s.name.rsplit("@", 1)[1])
+            assert bucket <= 2048
+
+
+def test_driver_lower_smoke_cheap_program():
+    """spec.lower() on the cheapest scalar-field program returns an AOT
+    Lowered (compile()-able); the driver records it as 'lowered'."""
+    stats = CompileStats()
+    specs = [s for s in cc.build_registry(cc.BENCH)
+             if s.op in ("fn_add", "int_to_scalar") and s.dispatched()]
+    assert specs, "scalar-field programs must dispatch on every backend"
+    lowered = specs[0].lower()
+    assert hasattr(lowered, "compile")
+    stats.record(specs[0].name, "lowered", lower_s=0.1)
+    assert stats.count("lowered") == 1
+
+
+def test_stats_headline_keys_and_totals():
+    stats = CompileStats()
+    stats.record("a", "compiled", lower_s=1.0, compile_s=2.0, cache="miss")
+    stats.record("b", "executed", lower_s=0.5, cache="hit")
+    stats.record("c", "skipped")
+    stats.record("d", "error", detail="boom")
+    t = stats.totals()
+    assert t["programs"] == 4 and t["errors"] == 1
+    assert t["persistent_hits"] == 1 and t["persistent_misses"] == 1
+    h = stats.headline()
+    assert h["compile_cache_programs"] == 4
+    assert h["compile_cache_compiled"] == 2      # compiled + executed
+    assert h["compile_cache_skipped"] == 1
+    assert h["compile_cache_trace_lower_seconds"] == 1.5
+    assert h["compile_cache_persistent_hits"] == 1
+    assert h["compile_cache_persistent_misses"] == 1
+    assert "a" in stats.table() and "error" in stats.table()
+
+
+def test_trace_guard_raises_recursion_limit():
+    before = sys.getrecursionlimit()
+    cc.trace_guard(min_recursion=max(before, 20000))
+    assert sys.getrecursionlimit() >= 20000
+
+
+def test_cli_list_exits_zero(capsys):
+    from drynx_tpu import precompile as cli
+
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "bucketed:fn_add" in out and "fused:dec" in out
+    assert "programs" in out
