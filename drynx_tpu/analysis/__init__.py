@@ -1,16 +1,21 @@
 """drynx_tpu.analysis — AST-based lint pass enforcing the repo's JAX/crypto
-invariants (jit-global-capture, unsafe-pickle, implicit-dtype,
-host-sync-in-hot-path, env-read-into-trace, secret-logging).
+invariants (jit-global-capture, cross-module-flag-capture, unsafe-pickle,
+implicit-dtype, host-sync-in-hot-path, pallas-operand-dtype,
+env-read-into-trace, secret-logging, hardcoded-timeout, thread-trace).
 
+Per-module rules walk one file; ``[project]`` rules get a
+:class:`ProjectInfo` (import graph + callgraph over the whole package).
 Run ``python -m drynx_tpu.analysis`` or see ANALYSIS.md. Deliberately
 jax-free so the linter works even when the accelerator stack is broken.
 """
 from .core import (REPO_ROOT, RULES, BaselineEntry, Finding, ModuleInfo,
                    Rule, analyze_paths, analyze_source, apply_baseline,
-                   load_baseline)
+                   load_baseline, module_info_for)
+from .project import ProjectInfo, ProjectRule, analyze_project
 from . import rules as _rules  # noqa: F401  (populate the registry)
 from .cli import DEFAULT_BASELINE, main
 
 __all__ = ["REPO_ROOT", "RULES", "BaselineEntry", "Finding", "ModuleInfo",
-           "Rule", "analyze_paths", "analyze_source", "apply_baseline",
-           "load_baseline", "DEFAULT_BASELINE", "main"]
+           "Rule", "ProjectInfo", "ProjectRule", "analyze_paths",
+           "analyze_project", "analyze_source", "apply_baseline",
+           "load_baseline", "module_info_for", "DEFAULT_BASELINE", "main"]

@@ -3,6 +3,12 @@
     python -m drynx_tpu.analysis [paths...]        # lint (default: drynx_tpu/)
     python -m drynx_tpu.analysis --list-rules
     python -m drynx_tpu.analysis --format json drynx_tpu/crypto
+    python -m drynx_tpu.analysis --changed-only    # pre-commit fast tier
+
+By default the whole-program pass runs too (import graph + callgraph, the
+``[project]`` rules); ``--no-project`` restricts to the per-module rules.
+Project findings carry a call chain, rendered as indented text and as a
+stable ``call_chain`` list in ``--format json``.
 
 Exit codes: 0 = clean (all findings baselined/suppressed), 1 = unbaselined
 findings (or stale baseline entries under --strict-baseline), 2 = usage.
@@ -11,13 +17,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 from typing import List, Optional
 
 from .core import (REPO_ROOT, RULES, analyze_paths, apply_baseline,
                    load_baseline)
+from .project import analyze_project
 from . import rules as _rules  # noqa: F401  (register the rule set)
+
+try:  # keep the linter usable even if the resilience package breaks
+    from ..resilience.policy import SUBPROCESS_TIMEOUT_S
+except Exception:  # pragma: no cover
+    SUBPROCESS_TIMEOUT_S = 30.0
 
 DEFAULT_BASELINE = REPO_ROOT / "LINT_BASELINE.json"
 
@@ -41,7 +54,45 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run only this rule (repeatable)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--list-rules", action="store_true")
+    proj = ap.add_mutually_exclusive_group()
+    proj.add_argument("--project", dest="project", action="store_true",
+                      default=True,
+                      help="run the whole-program pass too (default)")
+    proj.add_argument("--no-project", dest="project", action="store_false",
+                      help="per-module rules only (no import graph / "
+                           "callgraph)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs git HEAD (falls back "
+                         "to the full default scan when git is unavailable);"
+                         " implies --no-project")
     return ap
+
+
+def _changed_files() -> Optional[List[Path]]:
+    """Python files changed vs HEAD (staged + unstaged + untracked), or
+    None when git is unavailable / not a repo."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            timeout=SUBPROCESS_TIMEOUT_S)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            timeout=SUBPROCESS_TIMEOUT_S)
+        names = diff.stdout.splitlines()
+        if untracked.returncode == 0:
+            names += untracked.stdout.splitlines()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = []
+    for n in dict.fromkeys(names):
+        p = REPO_ROOT / n
+        if n.endswith(".py") and p.exists():
+            out.append(p)
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -49,7 +100,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for rid, rule in sorted(RULES.items()):
-            print(f"{rid}: {rule.summary}")
+            mark = " [project]" if rule.project else ""
+            print(f"{rid}{mark}: {rule.summary}")
         return 0
 
     for rid in args.rules or ():
@@ -58,19 +110,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
-    paths = args.paths or [REPO_ROOT / "drynx_tpu"]
+    project_mode = args.project
+    if args.changed_only:
+        changed = _changed_files()
+        if changed is None:
+            print("git unavailable; falling back to a full scan",
+                  file=sys.stderr)
+            paths = args.paths or [REPO_ROOT / "drynx_tpu"]
+        elif not changed:
+            print("no changed python files", file=sys.stderr)
+            return 0
+        else:
+            paths = changed
+            # a partial file set has no meaningful import graph
+            project_mode = False
+    else:
+        paths = args.paths or [REPO_ROOT / "drynx_tpu"]
     for p in paths:
         if not Path(p).exists():
             print(f"no such path: {p}", file=sys.stderr)
             return 2
 
-    findings = analyze_paths(paths, rules=args.rules)
+    if project_mode:
+        findings = analyze_project(paths, rules=args.rules)
+    else:
+        findings = analyze_paths(paths, rules=args.rules)
     baseline = [] if args.no_baseline else load_baseline(args.baseline)
     unbaselined, matched, stale = apply_baseline(findings, baseline)
 
     if args.format == "json":
         print(json.dumps({
-            "findings": [f.__dict__ for f in unbaselined],
+            "findings": [f.to_json() for f in unbaselined],
             "baselined": matched,
             "stale_baseline_entries": [e.__dict__ for e in stale],
         }, indent=2))
@@ -80,9 +150,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in stale:
             print(f"stale baseline entry (prune it): [{e.rule}] {e.file}: "
                   f"{e.line_text!r}", file=sys.stderr)
-        summary = (f"{len(unbaselined)} finding(s)"
-                   f" ({matched} baselined) in {len(set(f.file for f in findings))or 0} "
-                   f"file(s) with findings")
+        summary = (f"{len(unbaselined)} finding(s) ({matched} baselined) in "
+                   f"{len({f.file for f in findings})} file(s) with findings")
         print(summary, file=sys.stderr)
 
     if unbaselined:

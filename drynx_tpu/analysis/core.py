@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import re
 from pathlib import Path
@@ -46,12 +47,28 @@ class Finding:
     line: int          # 1-based
     message: str
     line_text: str     # stripped source line (baseline key component)
+    # Project-rule extras. call_chain renders the path that made the finding
+    # fire ("file:line:symbol" hops — import chain for flag taint, call
+    # chain for transitive host syncs, dtype-proof trail for pallas
+    # operands). anchors are extra (file, line) suppression points: a
+    # callgraph finding is suppressible at the sync site OR the jit entry.
+    call_chain: Tuple[str, ...] = ()
+    anchors: Tuple[Tuple[str, int], ...] = ()
 
     def key(self) -> Tuple[str, str, str]:
         return (self.rule, self.file, self.line_text)
 
     def render(self) -> str:
-        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+        out = f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+        if self.call_chain:
+            out += "\n    call chain: " + " -> ".join(self.call_chain)
+        return out
+
+    def to_json(self) -> Dict[str, object]:
+        """Stable JSON shape for --format json (call_chain always a list)."""
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "line_text": self.line_text,
+                "call_chain": list(self.call_chain)}
 
 
 class Rule:
@@ -59,14 +76,18 @@ class Rule:
 
     id: str = ""
     summary: str = ""
+    project = False    # ProjectRule flips this; --list-rules marks it
 
     def run(self, mod: "ModuleInfo") -> Iterator[Finding]:
         raise NotImplementedError
 
-    def finding(self, mod: "ModuleInfo", node: ast.AST, message: str) -> Finding:
+    def finding(self, mod: "ModuleInfo", node: ast.AST, message: str,
+                call_chain: Sequence[str] = (),
+                anchors: Sequence[Tuple[str, int]] = ()) -> Finding:
         line = getattr(node, "lineno", 1)
         return Finding(rule=self.id, file=mod.relpath, line=line,
-                       message=message, line_text=mod.line_text(line))
+                       message=message, line_text=mod.line_text(line),
+                       call_chain=tuple(call_chain), anchors=tuple(anchors))
 
 
 RULES: Dict[str, Rule] = {}
@@ -219,22 +240,59 @@ class ModuleInfo:
             return self.lines[lineno - 1].strip()
         return ""
 
-    def suppressed(self, finding: Finding) -> bool:
+    def noqa_match(self, rule: str, line: int) -> bool:
         raw = ""
-        if 1 <= finding.line <= len(self.lines):
-            raw = self.lines[finding.line - 1]
+        if 1 <= line <= len(self.lines):
+            raw = self.lines[line - 1]
         m = _NOQA_RE.search(raw)
         if not m:
             return False
         if m.group(1) is None:
             return True
         allowed = {r.strip() for r in m.group(1).split(",")}
-        return finding.rule in allowed
+        return rule in allowed
+
+    def suppressed(self, finding: Finding) -> bool:
+        if self.noqa_match(finding.rule, finding.line):
+            return True
+        # same-file extra anchors (jit entry of a callgraph finding)
+        return any(file == self.relpath and self.noqa_match(finding.rule, ln)
+                   for file, ln in finding.anchors)
 
 
 # ---------------------------------------------------------------------------
 # Scanning
 # ---------------------------------------------------------------------------
+
+# Parse results are cached on (content sha256, relpath): the per-module pass
+# and the project pass (and repeated runs in one process, e.g. the test
+# suite) share one ModuleInfo per file version instead of re-parsing.
+_INFO_CACHE: Dict[Tuple[str, str], "ModuleInfo"] = {}
+_INFO_CACHE_MAX = 4096
+
+
+def module_info_for(source: str, relpath: str) -> "ModuleInfo":
+    """ModuleInfo for (source, relpath), memoized on the content hash.
+    Raises SyntaxError like the constructor."""
+    key = (hashlib.sha256(source.encode("utf-8")).hexdigest(), relpath)
+    info = _INFO_CACHE.get(key)
+    if info is None:
+        if len(_INFO_CACHE) >= _INFO_CACHE_MAX:
+            _INFO_CACHE.clear()
+        info = ModuleInfo(source, relpath)
+        _INFO_CACHE[key] = info
+    return info
+
+
+def suppressed_at(finding: Finding, modules: Dict[str, "ModuleInfo"]) -> bool:
+    """True when a noqa for the rule sits on the finding line OR on any of
+    its extra anchors (e.g. the jit entry of a callgraph finding)."""
+    for file, line in ((finding.file, finding.line), *finding.anchors):
+        mod = modules.get(file)
+        if mod is not None and mod.noqa_match(finding.rule, line):
+            return True
+    return False
+
 
 def _rel(path: Path) -> str:
     p = path.resolve()
@@ -260,7 +318,7 @@ def analyze_source(source: str, relpath: str,
     from . import rules as _rules  # noqa: F401  (side effect: registration)
 
     try:
-        mod = ModuleInfo(source, relpath)
+        mod = module_info_for(source, relpath)
     except SyntaxError as e:
         return [Finding(rule="parse-error", file=relpath,
                         line=e.lineno or 1,

@@ -1,0 +1,194 @@
+"""Project-level analysis: ProjectInfo, ProjectRule and the driver.
+
+``analyze_project`` parses every file once (content-hash cached, shared
+with the per-module pass), builds the import graph and callgraph from
+:mod:`.graph`, then runs the per-module rules file by file AND the
+project rules over the whole :class:`ProjectInfo`. Project findings may
+carry a ``call_chain`` (rendered in text/JSON output) and extra
+``anchors`` — a callgraph finding is suppressible with ``# drynx:
+noqa[rule]`` at the sync site *or* at the jit entry it is reachable from.
+
+Still pure ``ast``, still no jax import.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import (Dict, Iterable, Iterator, List, Optional, Sequence, Set,
+                    Tuple)
+
+from .core import (RULES, Finding, ModuleInfo, Rule, _dotted, _rel,
+                   iter_py_files, module_info_for, suppressed_at)
+from .graph import CallGraph, ImportGraph, ModuleGraph, module_name
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagOrigin:
+    """Where a mutable flag is actually defined + why it is mutable."""
+    module: str                      # defining module (dotted)
+    relpath: str                     # defining file
+    name: str                        # name in the defining module
+    lineno: int                      # definition line
+    reason: str                      # "env" | "rebound" | "rebound-externally"
+    hops: Tuple[Tuple[str, int], ...]  # import chain (relpath, lineno)
+
+
+class ProjectInfo:
+    """The whole scanned package: per-file ModuleInfo + both graphs."""
+
+    def __init__(self, infos: Sequence[ModuleInfo]):
+        self.modules: Dict[str, ModuleInfo] = {i.relpath: i for i in infos}
+        self.graphs: Dict[str, ModuleGraph] = {}
+        for info in infos:
+            dotted = module_name(info.relpath)
+            is_pkg = info.relpath.endswith("__init__.py")
+            self.graphs[dotted] = ModuleGraph(info, dotted, is_pkg)
+        self.by_relpath: Dict[str, ModuleGraph] = {
+            mg.info.relpath: mg for mg in self.graphs.values()}
+        self.imports = ImportGraph(self.graphs)
+        self.calls = CallGraph(self.graphs, self.imports)
+        self.external_rebinds = self._collect_external_rebinds()
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def from_sources(cls, pairs: Iterable[Tuple[str, str]]) -> "ProjectInfo":
+        """Build from (relpath, source) pairs — the test entrypoint."""
+        return cls([module_info_for(src, rel) for rel, src in pairs])
+
+    @classmethod
+    def from_paths(cls, paths: Sequence[Path],
+                   ) -> Tuple["ProjectInfo", List[Finding]]:
+        """Build from files/dirs; unparseable files come back as
+        parse-error findings instead of ProjectInfo members."""
+        infos: List[ModuleInfo] = []
+        errors: List[Finding] = []
+        for path in iter_py_files(paths):
+            rel = _rel(path)
+            try:
+                source = path.read_text(encoding="utf-8")
+                infos.append(module_info_for(source, rel))
+            except (OSError, UnicodeDecodeError) as e:
+                errors.append(Finding(rule="parse-error", file=rel, line=1,
+                                      message=f"unreadable file: {e}",
+                                      line_text=""))
+            except SyntaxError as e:
+                errors.append(Finding(rule="parse-error", file=rel,
+                                      line=e.lineno or 1,
+                                      message=f"file does not parse: {e.msg}",
+                                      line_text=""))
+        return cls(infos), errors
+
+    # -- derived facts ----------------------------------------------------
+
+    def _collect_external_rebinds(self) -> Dict[str, Set[str]]:
+        """module dotted -> attribute names some OTHER module assigns on it
+        (`po.INTERPRET = True` style): mutable even if the defining module
+        never rebinds them itself."""
+        out: Dict[str, Set[str]] = {}
+        for mg in self.graphs.values():
+            for node in ast.walk(mg.info.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not isinstance(t, ast.Attribute):
+                        continue
+                    d = _dotted(t)
+                    if not d or d.count(".") != 1:
+                        continue
+                    alias, attr = d.split(".")
+                    target = self.imports.module_for_alias(mg.dotted, alias)
+                    if target is not None and target != mg.dotted:
+                        out.setdefault(target, set()).add(attr)
+        return out
+
+    def flag_origin(self, module: str, name: str) -> Optional[FlagOrigin]:
+        """Resolve (module, name) through import chains; return a
+        FlagOrigin iff the *defining* binding is mutable (env-derived,
+        rebound in its module, or attribute-rebound from outside)."""
+        def_mod, def_name, hops = self.imports.resolve(module, name)
+        mg = self.graphs.get(def_mod)
+        if mg is None or not def_name:
+            return None
+        info = mg.info
+        reason = None
+        lineno = 1
+        if def_name in info.env_derived:
+            reason, lineno = "env", info.env_derived[def_name].lineno
+        elif def_name in info.rebound:
+            reason = "rebound"
+            assigns = info.module_assigns.get(def_name)
+            lineno = assigns[0].lineno if assigns else 1
+        elif def_name in self.external_rebinds.get(def_mod, ()):
+            reason = "rebound-externally"
+            assigns = info.module_assigns.get(def_name)
+            lineno = assigns[0].lineno if assigns else 1
+        if reason is None:
+            return None
+        return FlagOrigin(module=def_mod, relpath=info.relpath, name=def_name,
+                          lineno=lineno, reason=reason, hops=tuple(hops))
+
+    # -- golden-test shape -------------------------------------------------
+
+    def to_json(self) -> Dict[str, object]:
+        """Deterministic JSON view of both graphs (golden-test surface).
+        Only structure — no AST nodes, no absolute paths."""
+        imports: Dict[str, object] = {}
+        for dotted in sorted(self.graphs):
+            mg = self.graphs[dotted]
+            imports[dotted] = {
+                "file": mg.info.relpath,
+                "froms": {n: {"module": b.target_module,
+                              "name": b.target_name, "line": b.lineno}
+                          for n, b in sorted(mg.froms.items())},
+                "aliases": {n: {"module": a.target_module, "line": a.lineno}
+                            for n, a in sorted(mg.aliases.items())},
+            }
+        callgraph = {
+            fid: sorted({s.callee for s in sites})
+            for fid, sites in sorted(self.calls.calls.items())}
+        return {"imports": imports, "callgraph": callgraph,
+                "traced_entries": sorted(self.calls.traced_entries)}
+
+
+class ProjectRule(Rule):
+    """A rule that needs the whole project. ``run`` (per-module) defaults
+    to nothing; subclasses that keep a lexical component may override both.
+    ``--list-rules`` marks these ``[project]``."""
+
+    project = True
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        return iter(())
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def chain_hop(relpath: str, lineno: int, symbol: str) -> str:
+    """One rendered call-chain hop: ``file:line:symbol``."""
+    return f"{relpath}:{lineno}:{symbol}"
+
+
+def analyze_project(paths: Sequence[Path],
+                    rules: Optional[Iterable[str]] = None,
+                    ) -> List[Finding]:
+    """Whole-program pass: per-module rules on every file + project rules
+    over the ProjectInfo, noqa applied at the finding line or any anchor."""
+    from . import rules as _rules  # noqa: F401  (side effect: registration)
+
+    project, findings = ProjectInfo.from_paths(paths)
+    selected = list(RULES.values() if rules is None
+                    else [RULES[r] for r in rules])
+    for relpath in sorted(project.modules):
+        mod = project.modules[relpath]
+        for rule in selected:
+            findings.extend(rule.run(mod))
+    for rule in selected:
+        if rule.project:
+            findings.extend(rule.run_project(project))
+    findings = [f for f in findings
+                if not suppressed_at(f, project.modules)]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings

@@ -8,15 +8,12 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Set
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
 
 from .core import (Finding, ModuleInfo, Rule, _contains_env_read, _dotted,
                    _local_bindings, register)
-
-# Flags mutated at runtime by tests/kill-switches even when a module only
-# *imports* them (e.g. pallas_pairing re-exports pallas_ops.INTERPRET and
-# tests monkeypatch both copies).
-KNOWN_MUTABLE_FLAGS = {"INTERPRET", "ENABLED", "UNROLL"}
+from .graph import FuncNode, _own_calls, _own_returns
+from .project import ProjectInfo, ProjectRule, chain_hop
 
 _SECRET_RE = re.compile(
     r"(^|_)(sk|secret|secrets|priv|privkey|private(_?key)?)(_|$)|secret",
@@ -32,7 +29,11 @@ def _in_scope(mod: ModuleInfo, *parts: str) -> bool:
 
 
 def _is_drynx_pkg(mod: ModuleInfo) -> bool:
-    return mod.relpath.startswith("drynx_tpu/") or "/drynx_tpu/" in mod.relpath
+    # lintpkg is the test fixture package: it opts into the scoped rules so
+    # the project-level pass can be exercised end-to-end from the CLI.
+    return (mod.relpath.startswith("drynx_tpu/")
+            or "/drynx_tpu/" in mod.relpath
+            or "lintpkg" in mod.relpath)
 
 
 # ---------------------------------------------------------------------------
@@ -44,16 +45,17 @@ class JitGlobalCapture(Rule):
     (monkeypatch, kill-switch) silently reuses stale traces — exactly the
     INTERPRET trace-cache leak in ADVICE.md. Pass such values as static
     arguments, or accept the capture explicitly via the baseline + a
-    cache-clearing teardown."""
+    cache-clearing teardown. This rule covers flags defined in the SAME
+    module; imported ones are handled by cross-module-flag-capture, which
+    propagates real mutability through the import graph instead of the
+    old KNOWN_MUTABLE_FLAGS allowlist."""
 
     id = "jit-global-capture"
     summary = ("jit-traced code reads a mutable module-level flag; the value "
                "is frozen into the trace cache at first call")
 
     def run(self, mod: ModuleInfo) -> Iterator[Finding]:
-        mutable = (set(mod.env_derived) | mod.rebound |
-                   (KNOWN_MUTABLE_FLAGS &
-                    _imported_or_assigned_names(mod)))
+        mutable = set(mod.env_derived) | mod.rebound
         if not mutable:
             return
         for fn in mod.traced_functions:
@@ -67,14 +69,6 @@ class JitGlobalCapture(Rule):
                         f"trace-time capture of mutable module global "
                         f"'{sub.id}' in '{fn.name}' — value is frozen into "
                         f"the jit/pallas trace cache")
-
-
-def _imported_or_assigned_names(mod: ModuleInfo) -> Set[str]:
-    names = set(mod.module_assigns)
-    for node in mod.tree.body:
-        if isinstance(node, ast.ImportFrom):
-            names.update(a.asname or a.name for a in node.names)
-    return names
 
 
 # ---------------------------------------------------------------------------
@@ -153,49 +147,151 @@ class ImplicitDtype(Rule):
 
 # ---------------------------------------------------------------------------
 @register
-class HostSyncInHotPath(Rule):
+class HostSyncInHotPath(ProjectRule):
     """Inside jit-traced crypto/parallel code, float()/int()/bool()/
     np.asarray() on a traced value either crashes at trace time or forces a
     device->host sync that serializes the pipeline; .block_until_ready()
     inside a trace is always a mistake. Heuristic taint: function
-    parameters (minus static_argnames) and locals derived from them."""
+    parameters (minus static_argnames) and locals derived from them.
+
+    The per-module pass (``run``) checks jit/pallas bodies lexically. The
+    project pass (``run_project``) follows the callgraph: a sync inside a
+    plain helper *transitively reachable* from a jit entry fires too, with
+    the call chain rendered and the finding suppressible at the sync site
+    OR the entry. Reads of ``.shape/.ndim/.dtype/.size`` are host metadata
+    and never taint."""
 
     id = "host-sync-in-hot-path"
-    summary = ("host-synchronizing call on a traced value inside jitted "
-               "crypto/ or parallel/ code")
+    summary = ("host-synchronizing call on a traced value inside (or "
+               "transitively reachable from) jitted crypto/ or parallel/ "
+               "code")
 
     _HOST_CASTS = {"float", "int", "bool"}
     _HOST_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
     _SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+    _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+    _MAX_DEPTH = 5
 
     def run(self, mod: ModuleInfo) -> Iterator[Finding]:
         if not (_is_drynx_pkg(mod) and _in_scope(mod, "crypto", "parallel")):
             return
         for fn in mod.traced_functions:
             tainted = self._tainted_names(fn)
-            for sub in ast.walk(fn):
-                if not isinstance(sub, ast.Call):
+            for sub, what in self._body_syncs(fn, tainted):
+                yield self.finding(
+                    mod, sub,
+                    f"'{what}' on a traced value inside jit-traced "
+                    f"'{fn.name}' — crashes at trace time or forces a "
+                    f"device->host sync")
+
+    def _body_syncs(self, fn: ast.AST, tainted: Set[str],
+                    ) -> Iterator[Tuple[ast.Call, str]]:
+        """(call node, rendered sink) for every host sync on a tainted
+        value lexically in fn (nested defs included: they close over the
+        same traced values)."""
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in self._SYNC_METHODS):
+                if sub.func.attr == "block_until_ready" or \
+                        self._refs_tainted(sub.func.value, tainted):
+                    yield sub, f".{sub.func.attr}()"
+                continue
+            name = d if d in self._HOST_FUNCS else (
+                sub.func.id if isinstance(sub.func, ast.Name)
+                and sub.func.id in self._HOST_CASTS else None)
+            if name and any(self._refs_tainted(a, tainted)
+                            for a in sub.args):
+                yield sub, f"{name}()"
+
+    # -- project pass: follow the callgraph out of jit entries ------------
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        reported: Set[Tuple[str, int]] = set()
+        for fid in sorted(project.calls.traced_entries):
+            entry = project.calls.functions.get(fid)
+            if entry is None:
+                continue
+            mg = project.graphs[entry.module]
+            if not (_is_drynx_pkg(mg.info)
+                    and _in_scope(mg.info, "crypto", "parallel")):
+                continue
+            # decorator-marked entries' own bodies are covered lexically by
+            # run(); wrapper-marked ones (g = jax.jit(f), bucketed(f)) are
+            # not, so include their bodies here.
+            decorated = any(entry.node is f for f in mg.info.traced_functions)
+            chain = [chain_hop(mg.info.relpath, entry.node.lineno,
+                               entry.qual)]
+            anchors = ((mg.info.relpath, entry.node.lineno),)
+            yield from self._walk_entry(
+                project, entry, frozenset(self._tainted_names(entry.node)),
+                chain, anchors, include_body=not decorated,
+                reported=reported, visited=set(), depth=0)
+
+    def _walk_entry(self, project: ProjectInfo, fn: FuncNode,
+                    tainted_params: FrozenSet[str], chain: List[str],
+                    anchors: Tuple[Tuple[str, int], ...], include_body: bool,
+                    reported: Set[Tuple[str, int]],
+                    visited: Set[Tuple[str, FrozenSet[str]]], depth: int,
+                    ) -> Iterator[Finding]:
+        key = (fn.fid, tainted_params)
+        if key in visited or depth > self._MAX_DEPTH:
+            return
+        visited.add(key)
+        mg = project.graphs[fn.module]
+        tainted = self._propagate(fn.node, set(tainted_params))
+        if include_body:
+            for sub, what in self._body_syncs(fn.node, tainted):
+                site = (mg.info.relpath, sub.lineno)
+                if site in reported:
                     continue
-                d = _dotted(sub.func)
-                if (isinstance(sub.func, ast.Attribute)
-                        and sub.func.attr in self._SYNC_METHODS):
-                    if sub.func.attr == "block_until_ready" or \
-                            self._refs_tainted(sub.func.value, tainted):
-                        yield self.finding(
-                            mod, sub,
-                            f"'.{sub.func.attr}()' inside jit-traced "
-                            f"'{fn.name}' forces a host sync")
-                    continue
-                name = d if d in self._HOST_FUNCS else (
-                    sub.func.id if isinstance(sub.func, ast.Name)
-                    and sub.func.id in self._HOST_CASTS else None)
-                if name and any(self._refs_tainted(a, tainted)
-                                for a in sub.args):
-                    yield self.finding(
-                        mod, sub,
-                        f"'{name}()' on a traced value inside jit-traced "
-                        f"'{fn.name}' — crashes at trace time or forces a "
-                        f"device->host sync")
+                reported.add(site)
+                full = chain + [chain_hop(mg.info.relpath, sub.lineno, what)]
+                yield self.finding(
+                    mg.info, sub,
+                    f"'{what}' on a traced value in '{fn.qual}', reachable "
+                    f"from jit entry '{chain[0].rsplit(':', 1)[-1]}' — "
+                    f"forces a device->host sync inside the trace",
+                    call_chain=full, anchors=anchors)
+        for site in project.calls.callees(fn.fid):
+            callee = project.calls.functions.get(site.callee)
+            if callee is None or callee.fid in project.calls.traced_entries:
+                continue  # traced callees are analyzed as their own entries
+            passed = self._callee_taint(site.node, callee.node, tainted)
+            if not passed:
+                continue
+            hop = chain_hop(mg.info.relpath, site.lineno, callee.qual)
+            yield from self._walk_entry(
+                project, callee, frozenset(passed), chain + [hop], anchors,
+                include_body=True, reported=reported, visited=visited,
+                depth=depth + 1)
+
+    def _callee_taint(self, call: ast.Call, callee: ast.AST,
+                      tainted: Set[str]) -> Set[str]:
+        """Callee parameter names that receive tainted arguments."""
+        args = callee.args
+        params = [a.arg for a in (args.posonlyargs + args.args)
+                  if a.arg != "self"]
+        static = self._static_args(callee)
+        out: Set[str] = set()
+        splat = False
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                splat = splat or self._refs_tainted(a, tainted)
+                continue
+            if self._refs_tainted(a, tainted) and i < len(params):
+                out.add(params[i])
+        for kw in call.keywords:
+            if kw.arg is None:
+                splat = splat or self._refs_tainted(kw.value, tainted)
+            elif self._refs_tainted(kw.value, tainted):
+                out.add(kw.arg)
+        if splat:
+            out.update(params)
+        return out - static
 
     @staticmethod
     def _static_args(fn: ast.AST) -> Set[str]:
@@ -214,9 +310,12 @@ class HostSyncInHotPath(Rule):
     def _tainted_names(self, fn: ast.AST) -> Set[str]:
         static = self._static_args(fn)
         args = fn.args
-        tainted = {a.arg for a in
-                   (args.posonlyargs + args.args + args.kwonlyargs)
-                   if a.arg not in static and a.arg != "self"}
+        start = {a.arg for a in
+                 (args.posonlyargs + args.args + args.kwonlyargs)
+                 if a.arg not in static and a.arg != "self"}
+        return self._propagate(fn, start)
+
+    def _propagate(self, fn: ast.AST, tainted: Set[str]) -> Set[str]:
         # one forward pass of simple propagation through assignments
         for stmt in ast.walk(fn):
             if isinstance(stmt, ast.Assign) \
@@ -227,10 +326,17 @@ class HostSyncInHotPath(Rule):
                             tainted.add(n.id)
         return tainted
 
-    @staticmethod
-    def _refs_tainted(node: ast.AST, tainted: Set[str]) -> bool:
-        return any(isinstance(n, ast.Name) and n.id in tainted
-                   for n in ast.walk(node))
+    @classmethod
+    def _refs_tainted(cls, node: ast.AST, tainted: Set[str]) -> bool:
+        # x.shape / x.ndim / x.dtype / x.size are host-side metadata: code
+        # like `int(np.prod(x.shape[:3]))` never syncs the device buffer.
+        def walk(n: ast.AST) -> bool:
+            if isinstance(n, ast.Attribute) and n.attr in cls._SHAPE_ATTRS:
+                return False
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+            return any(walk(c) for c in ast.iter_child_nodes(n))
+        return walk(node)
 
 
 # ---------------------------------------------------------------------------
@@ -522,3 +628,421 @@ class ThreadTrace(Rule):
             if hit:
                 return hit
         return None
+
+
+# ---------------------------------------------------------------------------
+@register
+class CrossModuleFlagCapture(ProjectRule):
+    """The import-graph version of jit-global-capture: a flag assigned
+    from os.environ or rebound at runtime *anywhere* in the project, then
+    imported (through any number of re-export hops) or read via a module
+    alias, taints every jit/pallas body that reads it — the read is
+    evaluated once at trace time and frozen into the cache. This replaces
+    the old KNOWN_MUTABLE_FLAGS allowlist with real propagation: only
+    flags that are actually mutable at their definition fire."""
+
+    id = "cross-module-flag-capture"
+    summary = ("jit/pallas-traced code reads a mutable flag defined in "
+               "another module (env-derived or rebound) — frozen into the "
+               "trace cache")
+
+    _REASONS = {"env": "assigned from os.environ",
+                "rebound": "rebound at runtime",
+                "rebound-externally": "attribute-rebound from another module"}
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        for dotted in sorted(project.graphs):
+            mg = project.graphs[dotted]
+            info = mg.info
+            if not info.traced_functions:
+                continue
+            for fn in info.traced_functions:
+                local = _local_bindings(fn)
+                seen: Set[str] = set()
+                for sub in ast.walk(fn):
+                    hit = self._mutable_read(project, dotted, local, sub)
+                    if hit is None:
+                        continue
+                    token, origin = hit
+                    if token in seen or origin.module == dotted:
+                        continue
+                    seen.add(token)
+                    chain = [chain_hop(info.relpath, sub.lineno, token)]
+                    chain += [chain_hop(rel, ln, "import")
+                              for rel, ln in origin.hops]
+                    chain.append(chain_hop(
+                        origin.relpath, origin.lineno,
+                        f"{origin.name} ({self._REASONS[origin.reason]})"))
+                    yield self.finding(
+                        info, sub,
+                        f"trace-time capture of mutable flag '{token}' in "
+                        f"'{fn.name}' — defined in {origin.module} and "
+                        f"{self._REASONS[origin.reason]}; the value is "
+                        f"frozen into the jit/pallas trace cache",
+                        call_chain=chain,
+                        anchors=((origin.relpath, origin.lineno),))
+
+    @staticmethod
+    def _mutable_read(project, dotted, local, sub):
+        """(rendered token, FlagOrigin) when `sub` is a Load of a mutable
+        cross-module flag, else None."""
+        mg = project.graphs[dotted]
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                and sub.id not in local and sub.id in mg.froms:
+            origin = project.flag_origin(dotted, sub.id)
+            if origin is not None:
+                return sub.id, origin
+        elif isinstance(sub, ast.Attribute) and isinstance(sub.ctx, ast.Load):
+            d = _dotted(sub)
+            if d and d.count(".") == 1:
+                alias, attr = d.split(".")
+                if alias not in local:
+                    target = project.imports.module_for_alias(dotted, alias)
+                    if target is not None and target != dotted \
+                            and target in project.graphs:
+                        origin = project.flag_origin(target, attr)
+                        if origin is not None:
+                            return d, origin
+        return None
+
+
+# ---------------------------------------------------------------------------
+_UINT32_DTYPES = {"jnp.uint32", "np.uint32", "numpy.uint32",
+                  "jax.numpy.uint32"}
+
+
+def _is_uint32_dtype(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Constant) and expr.value == "uint32":
+        return True
+    return _dotted(expr) in _UINT32_DTYPES
+
+
+@register
+class PallasOperandDtype(ProjectRule):
+    """Mosaic kernels in this repo are exact uint32 limb arithmetic with
+    x64 disabled: a pallas_call operand that arrives as weak int32/float32
+    (or x64-demoted int64) silently truncates limbs inside the kernel.
+    Every ``pl.pallas_call(...)(operands...)`` operand must be *provably*
+    uint32: a literal dtype at the constructor, a dtype-preserving chain
+    (reshape/transpose/indexing/uint32 arithmetic) rooted at one, a
+    callgraph hop through a helper whose returns pin uint32 (e.g.
+    ``_pad_lanes``), or — for operands that are function parameters — a
+    reverse hop proving every project call site passes uint32."""
+
+    id = "pallas-operand-dtype"
+    summary = ("pl.pallas_call operand not provably uint32 — weak/implicit "
+               "dtypes miscompile the Mosaic limb kernels")
+
+    _CTOR_DTYPE_POS = {"array": 1, "asarray": 1, "zeros": 1, "ones": 1,
+                       "empty": 1, "full": 2}
+    _ARRAY_NS = {"jnp", "np", "numpy", "jax.numpy"}
+    # dtype(out) == dtype(arg0)
+    _PRESERVING_FUNCS = {"transpose", "reshape", "concatenate", "stack",
+                         "broadcast_to", "tile", "repeat", "flip", "roll",
+                         "moveaxis", "swapaxes", "expand_dims", "squeeze",
+                         "ravel", "pad", "zeros_like", "ones_like",
+                         "empty_like", "full_like", "flipud", "rot90"}
+    _PRESERVING_METHODS = {"reshape", "transpose", "ravel", "squeeze",
+                           "swapaxes", "copy", "flatten"}
+    _MAX_DEPTH = 8
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        self._pins_memo: Dict[Tuple[str, Optional[int]], bool] = {}
+        self._ctx_memo: Dict[Tuple[str, int], tuple] = {}
+        for dotted in sorted(project.graphs):
+            mg = project.graphs[dotted]
+            info = mg.info
+            if not (_is_drynx_pkg(info)
+                    and _in_scope(info, "crypto", "parallel")):
+                continue
+            for qual in sorted(mg.functions):
+                fn = mg.functions[qual]
+                for call in _own_calls(fn.node):
+                    if not (isinstance(call.func, ast.Call)
+                            and (_dotted(call.func.func) or ""
+                                 ).split(".")[-1] == "pallas_call"):
+                        continue
+                    for i, op in enumerate(call.args):
+                        trail = [chain_hop(info.relpath, call.lineno,
+                                           f"pallas_call operand {i}")]
+                        if self._prove(project, fn, op, trail, 0, set()):
+                            continue
+                        try:
+                            src = ast.unparse(op)
+                        except Exception:
+                            src = "<operand>"
+                        if len(src) > 48:
+                            src = src[:45] + "..."
+                        yield self.finding(
+                            info, op,
+                            f"pallas_call operand {i} ('{src}') in "
+                            f"'{fn.qual}' is not provably uint32 — coerce "
+                            f"with jnp.asarray(..., jnp.uint32) or pin the "
+                            f"dtype in the producing helper",
+                            call_chain=trail[:6],
+                            anchors=((info.relpath, call.lineno),))
+
+    # -- the prover -------------------------------------------------------
+
+    def _ctx(self, project: ProjectInfo, fn: FuncNode):
+        """(assigns, params, sites) for a function: last simple assignment
+        per name, parameter names, and call-node -> callee-fid map."""
+        key = (fn.fid, id(fn.node))
+        cached = self._ctx_memo.get(key)
+        if cached is not None:
+            return cached
+        assigns: Dict[str, tuple] = {}
+        for stmt in ast.walk(fn.node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            t = stmt.targets[0] if len(stmt.targets) == 1 else None
+            if isinstance(t, ast.Name):
+                assigns[t.id] = ("expr", stmt.value, stmt.lineno)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for idx, el in enumerate(t.elts):
+                    if isinstance(el, ast.Name):
+                        assigns[el.id] = ("unpack", idx, stmt.value,
+                                          stmt.lineno)
+        a = fn.node.args
+        params = [x.arg for x in (a.posonlyargs + a.args) if x.arg != "self"]
+        sites = {id(s.node): s.callee
+                 for s in project.calls.callees(fn.fid)}
+        self._ctx_memo[key] = (assigns, params, sites)
+        return assigns, params, sites
+
+    def _prove(self, project: ProjectInfo, fn: FuncNode, expr: ast.AST,
+               trail: List[str], depth: int, visiting: Set[tuple]) -> bool:
+        if depth > self._MAX_DEPTH:
+            return False
+        mg = project.graphs[fn.module]
+        rel = mg.info.relpath
+        assigns, params, sites = self._ctx(project, fn)
+
+        if isinstance(expr, ast.Starred):
+            return self._prove(project, fn, expr.value, trail, depth,
+                               visiting)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return all(self._prove(project, fn, e, trail, depth + 1,
+                                   visiting) for e in expr.elts)
+        if isinstance(expr, ast.Subscript):
+            return self._prove(project, fn, expr.value, trail, depth + 1,
+                               visiting)
+        if isinstance(expr, ast.IfExp):
+            return (self._prove(project, fn, expr.body, trail, depth + 1,
+                                visiting)
+                    and self._prove(project, fn, expr.orelse, trail,
+                                    depth + 1, visiting))
+        if isinstance(expr, ast.BinOp):
+            # uint32 op uint32 stays uint32; weak python int literals do
+            # not promote it under x64-off
+            ops = [expr.left, expr.right]
+            arr = [o for o in ops if not (isinstance(o, ast.Constant)
+                                          and isinstance(o.value, int))]
+            return bool(arr) and all(
+                self._prove(project, fn, o, trail, depth + 1, visiting)
+                for o in arr)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr == "T":
+                return self._prove(project, fn, expr.value, trail,
+                                   depth + 1, visiting)
+            return False
+        if isinstance(expr, ast.Name):
+            got = assigns.get(expr.id)
+            if got is not None:
+                if got[0] == "expr":
+                    trail.append(chain_hop(rel, got[2],
+                                           f"{expr.id} = ..."))
+                    return self._prove(project, fn, got[1], trail,
+                                       depth + 1, visiting)
+                _, idx, value, lineno = got
+                trail.append(chain_hop(rel, lineno,
+                                       f"{expr.id} = ...[{idx}]"))
+                return self._prove_unpack(project, fn, value, idx, trail,
+                                          depth + 1, visiting)
+            if expr.id in params:
+                return self._param_proven(project, fn, expr.id, trail,
+                                          depth + 1, visiting)
+            return self._module_const_proven(project, fn.module, expr.id,
+                                             trail, depth + 1, visiting)
+        if isinstance(expr, ast.Call):
+            return self._prove_call(project, fn, expr, trail, depth,
+                                    visiting, sites)
+        return False
+
+    def _prove_call(self, project, fn, call, trail, depth, visiting, sites):
+        mg = project.graphs[fn.module]
+        rel = mg.info.relpath
+        d = _dotted(call.func) or ""
+        leaf = d.split(".")[-1]
+        root = d.rsplit(".", 1)[0] if "." in d else ""
+        if isinstance(call.func, ast.Attribute) and root not in self._ARRAY_NS:
+            # method call on an expression
+            if call.func.attr == "astype":
+                if call.args and _is_uint32_dtype(call.args[0]):
+                    trail.append(chain_hop(rel, call.lineno,
+                                           ".astype(uint32)"))
+                    return True
+                return False
+            if call.func.attr in self._PRESERVING_METHODS:
+                return self._prove(project, fn, call.func.value, trail,
+                                   depth + 1, visiting)
+        if root in self._ARRAY_NS:
+            dtype = next((kw.value for kw in call.keywords
+                          if kw.arg == "dtype"), None)
+            pos = self._CTOR_DTYPE_POS.get(leaf)
+            if dtype is None and pos is not None and len(call.args) > pos:
+                dtype = call.args[pos]
+            if dtype is not None:
+                if _is_uint32_dtype(dtype):
+                    trail.append(chain_hop(rel, call.lineno,
+                                           f"{d}(dtype=uint32)"))
+                    return True
+                return False
+            if leaf in ("array", "asarray") and call.args:
+                # no dtype: preserves the input's dtype
+                return self._prove(project, fn, call.args[0], trail,
+                                   depth + 1, visiting)
+            if leaf in self._PRESERVING_FUNCS and call.args:
+                return self._prove(project, fn, call.args[0], trail,
+                                   depth + 1, visiting)
+            return False
+        # callgraph hop: a project function whose returns pin uint32
+        callee_fid = sites.get(id(call))
+        if callee_fid is not None:
+            callee = project.calls.functions[callee_fid]
+            if self._fn_pins(project, callee, None, depth + 1, visiting):
+                trail.append(chain_hop(
+                    project.graphs[callee.module].info.relpath,
+                    callee.node.lineno, f"{callee.qual}() pins uint32"))
+                return True
+        return False
+
+    def _prove_unpack(self, project, fn, value, idx, trail, depth, visiting):
+        """`a, b = <value>` — prove element idx of the rhs."""
+        if isinstance(value, (ast.Tuple, ast.List)):
+            if idx < len(value.elts):
+                return self._prove(project, fn, value.elts[idx], trail,
+                                   depth, visiting)
+            return False
+        if isinstance(value, ast.Call):
+            _, _, sites = self._ctx(project, fn)
+            callee_fid = sites.get(id(value))
+            if callee_fid is not None:
+                callee = project.calls.functions[callee_fid]
+                if self._fn_pins(project, callee, idx, depth, visiting):
+                    trail.append(chain_hop(
+                        project.graphs[callee.module].info.relpath,
+                        callee.node.lineno,
+                        f"{callee.qual}()[{idx}] pins uint32"))
+                    return True
+        return False
+
+    def _fn_pins(self, project, fn: FuncNode, idx, depth, visiting) -> bool:
+        """True when every return of fn is provably uint32 (element idx of
+        tuple returns when idx is not None), regardless of its inputs."""
+        key = (fn.fid, idx)
+        if key in self._pins_memo:
+            return self._pins_memo[key]
+        vkey = ("pins", fn.fid, idx)
+        if vkey in visiting or depth > self._MAX_DEPTH:
+            return False
+        visiting.add(vkey)
+        returns = [r.value for r in _own_returns(fn.node)
+                   if r.value is not None]
+        ok = bool(returns)
+        for r in returns:
+            if idx is not None:
+                if isinstance(r, (ast.Tuple, ast.List)) and idx < len(r.elts):
+                    ok = ok and self._prove(project, fn, r.elts[idx],
+                                            [], depth + 1, visiting)
+                else:
+                    ok = ok and self._prove_unpack(project, fn, r, idx,
+                                                   [], depth + 1, visiting)
+            else:
+                ok = ok and self._prove(project, fn, r, [], depth + 1,
+                                        visiting)
+            if not ok:
+                break
+        visiting.discard(vkey)
+        self._pins_memo[key] = ok
+        return ok
+
+    def _param_proven(self, project, fn: FuncNode, pname, trail, depth,
+                      visiting) -> bool:
+        """Reverse hop: every project call site of fn passes a provably
+        uint32 value for parameter pname."""
+        vkey = ("param", fn.fid, pname)
+        if vkey in visiting or depth > self._MAX_DEPTH:
+            return False
+        visiting.add(vkey)
+        try:
+            a = fn.node.args
+            pos_params = [x.arg for x in (a.posonlyargs + a.args)]
+            pidx = pos_params.index(pname) if pname in pos_params else None
+            callers = [(cfid, s) for cfid, ss in project.calls.calls.items()
+                       for s in ss if s.callee == fn.fid]
+            if not callers:
+                return False
+            for cfid, site in callers:
+                caller = project.calls.functions[cfid]
+                arg = next((kw.value for kw in site.node.keywords
+                            if kw.arg == pname), None)
+                if arg is None and pidx is not None \
+                        and pidx < len(site.node.args):
+                    arg = site.node.args[pidx]
+                if arg is None:
+                    # default value used
+                    ndef = len(a.defaults)
+                    di = pidx - (len(pos_params) - ndef) \
+                        if pidx is not None else -1
+                    if not (0 <= di < ndef and self._prove(
+                            project, fn, a.defaults[di], [], depth + 1,
+                            visiting)):
+                        return False
+                    continue
+                if isinstance(arg, ast.Starred) or not self._prove(
+                        project, caller, arg, [], depth + 1, visiting):
+                    return False
+            trail.append(chain_hop(
+                project.graphs[fn.module].info.relpath, fn.node.lineno,
+                f"{fn.qual}({pname}) uint32 at all "
+                f"{len(callers)} call site(s)"))
+            return True
+        finally:
+            visiting.discard(vkey)
+
+    def _module_const_proven(self, project, module, name, trail, depth,
+                             visiting) -> bool:
+        """Module-level constant (possibly imported) provably uint32."""
+        vkey = ("mod", module, name)
+        if vkey in visiting or depth > self._MAX_DEPTH:
+            return False
+        visiting.add(vkey)
+        try:
+            def_mod, def_name, _hops = project.imports.resolve(module, name)
+            mg = project.graphs.get(def_mod)
+            if mg is None or not def_name:
+                return False
+            node = mg.info.env_derived.get(def_name)
+            if node is not None:
+                return False  # env-derived is never a provable dtype
+            assigns = mg.info.module_assigns.get(def_name)
+            if not assigns or len(assigns) != 1:
+                return False
+            ok = self._prove_module_expr(project, mg, assigns[0].value,
+                                         trail, depth + 1, visiting)
+            if ok:
+                trail.append(chain_hop(mg.info.relpath, assigns[0].lineno,
+                                       f"{def_name} pins uint32"))
+            return ok
+        finally:
+            visiting.discard(vkey)
+
+    def _prove_module_expr(self, project, mg, expr, trail, depth,
+                           visiting) -> bool:
+        """Prove a module-level expression: no params, no local assigns —
+        reuse the ctor/preserving logic via a synthetic module-scope
+        FuncNode whose body is empty."""
+        shim = FuncNode(mg.dotted, "<module>",
+                        ast.parse("def _m():\n    pass").body[0])
+        return self._prove(project, shim, expr, trail, depth, visiting)

@@ -385,8 +385,10 @@ def _fixed_base_mul_flat(table, k, n_windows: int, interpret: bool):
     n_tiles = max((N + LANES - 1) // LANES, 1)
     Np = n_tiles * LANES
     kt = _pad_lanes(jnp.transpose(k, (1, 0)), Np)      # (16, Np)
-    # (w, v, c, l) -> (w, l, c, v) -> (W, 16, 48)
-    tt = jnp.transpose(table[:W], (0, 3, 2, 1)).reshape(W, NL, 48)
+    # (w, v, c, l) -> (w, l, c, v) -> (W, 16, 48); the table comes from the
+    # caller (elgamal.FixedBase), so pin uint32 here like _pad_lanes does
+    tt = jnp.asarray(jnp.transpose(table[:W], (0, 3, 2, 1)),
+                     dtype=jnp.uint32).reshape(W, NL, 48)
 
     m_in = jnp.asarray(_M_FP[:, None], dtype=jnp.uint32)
     np_in = jnp.asarray([[_NPRIME_FP]], dtype=jnp.uint32)
@@ -460,6 +462,9 @@ def _mk_point_io(n_tiles, Np, extra=None):
 
 
 def _pad_lanes(x, Np):
+    # every Mosaic operand funnels through here: pin uint32 at the choke
+    # point so a weak int32/i64 limb tensor can never reach a kernel
+    x = jnp.asarray(x, dtype=jnp.uint32)
     N = x.shape[-1]
     if N == Np:
         return x

@@ -57,6 +57,8 @@ POLL_INTERVAL_S = 0.05
 COLD_COMPILE_WAIT_S = 2400.0
 # Client-side end_verification default (api.py).
 END_VERIFICATION_TIMEOUT_S = 600.0
+# Local helper subprocesses (git queries in tooling, never network calls).
+SUBPROCESS_TIMEOUT_S = 30.0
 
 # -- idempotency table ------------------------------------------------------
 # Read-only or set-once-overwrite handlers: re-execution is harmless.
@@ -124,4 +126,4 @@ __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
            "BACKOFF_JITTER", "CALL_TIMEOUT_S", "PING_TIMEOUT_S",
            "VERIFY_WAIT_S", "PROOF_DRAIN_S", "STRAGGLER_GRACE_S",
            "VN_GROUP_WAIT_S", "POLL_INTERVAL_S", "COLD_COMPILE_WAIT_S",
-           "END_VERIFICATION_TIMEOUT_S"]
+           "END_VERIFICATION_TIMEOUT_S", "SUBPROCESS_TIMEOUT_S"]

@@ -4,7 +4,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== static analysis (python -m drynx_tpu.analysis) =="
+echo "== changed-files lint (fast tier, per-module rules) =="
+python -m drynx_tpu.analysis --changed-only
+
+echo "== static analysis (python -m drynx_tpu.analysis, whole-program) =="
 python -m drynx_tpu.analysis drynx_tpu/ "$@"
 
 echo "== precompile registry smoke (trace+lower the proofs-on program set) =="

@@ -1,0 +1,2 @@
+"""crypto/ subpackage so the scoped rules (host-sync, pallas dtype)
+apply to the fixture the same way they apply to drynx_tpu/crypto/."""

@@ -1,0 +1,52 @@
+"""Fixture kernels: exactly one violation per project-level rule."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..reexport import FAST_MATH, LIMB_COUNT
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] + x_ref[:]
+
+
+@jax.jit
+def scaled(x):
+    # VIOLATION (cross-module-flag-capture): FAST_MATH is env-derived in
+    # lintpkg.flags and re-exported through lintpkg.reexport; reading it
+    # here freezes the value into the trace cache.
+    if FAST_MATH:
+        return x
+    return x * LIMB_COUNT
+
+
+@jax.jit
+def checksum(x):
+    return _accumulate(x)
+
+
+def _accumulate(v):
+    return _finalize(v + 1)
+
+
+def _finalize(v):
+    # VIOLATION (host-sync-in-hot-path via the callgraph): float() on a
+    # traced value two calls below the jit entry `checksum`.
+    return float(v)
+
+
+def double_tiles(n):
+    weak = jnp.zeros((8, 128), jnp.float32)
+    # VIOLATION (pallas-operand-dtype): `weak` is float32, not uint32.
+    return pl.pallas_call(
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+    )(weak)
+
+
+def double_tiles_ok(x):
+    good = jnp.asarray(x, dtype=jnp.uint32)
+    return pl.pallas_call(
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+    )(good)

@@ -1,0 +1,7 @@
+"""Env-derived flag: the mutability origin the taint pass must find."""
+import os
+
+FAST_MATH = os.environ.get("LINTPKG_FAST_MATH", "0") == "1"
+
+# a plain constant: NOT mutable, importing + reading it in a jit is fine
+LIMB_COUNT = 16

@@ -1,0 +1,5 @@
+"""One re-export hop between the flag and its reader: the import graph
+must resolve FAST_MATH back to lintpkg.flags through this module."""
+from .flags import FAST_MATH, LIMB_COUNT
+
+__all__ = ["FAST_MATH", "LIMB_COUNT"]

@@ -49,7 +49,26 @@ def test_jit_global_capture_fires_on_env_flag_in_jit():
     assert "FLAG" in found[0].message and "'f'" in found[0].message
 
 
-def test_jit_global_capture_fires_in_pallas_builder():
+def test_jit_global_capture_fires_on_local_rebound_flag_in_pallas_builder():
+    src = """
+        import jax.experimental.pallas as pl
+
+        INTERPRET = False
+
+        def enable():
+            global INTERPRET
+            INTERPRET = True
+
+        def builder(x):
+            return pl.pallas_call(_k, interpret=INTERPRET)(x)
+    """
+    found = run(src, rule="jit-global-capture")
+    assert len(found) == 1 and "INTERPRET" in found[0].message
+
+
+def test_jit_global_capture_ignores_imported_flags():
+    # imported flags are cross-module-flag-capture's job: the per-module
+    # pass cannot see whether the defining module makes them mutable.
     src = """
         from drynx_tpu.crypto.pallas_ops import INTERPRET
         import jax.experimental.pallas as pl
@@ -57,8 +76,7 @@ def test_jit_global_capture_fires_in_pallas_builder():
         def builder(x):
             return pl.pallas_call(_k, interpret=INTERPRET)(x)
     """
-    found = run(src, rule="jit-global-capture")
-    assert len(found) == 1 and "INTERPRET" in found[0].message
+    assert run(src, rule="jit-global-capture") == []
 
 
 def test_jit_global_capture_ignores_local_shadow_and_constants():
@@ -445,3 +463,233 @@ def test_thread_trace_suppressible_with_noqa():
         "threading.Thread(target=work).start()",
         "threading.Thread(target=work).start()  # drynx: noqa[thread-trace]")
     assert run(src, relpath=SERVICE_PATH, rule="thread-trace") == []
+
+
+# -- project-level rules ----------------------------------------------------
+# These need more than one file: build a ProjectInfo from (relpath, source)
+# pairs and drive the rule's run_project directly, with the same noqa
+# filtering analyze_project applies.
+
+from drynx_tpu.analysis import RULES, ProjectInfo  # noqa: E402
+from drynx_tpu.analysis.core import suppressed_at  # noqa: E402
+
+
+def run_project(pairs, rule):
+    project = ProjectInfo.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in pairs])
+    found = list(RULES[rule].run_project(project))
+    return [f for f in found if not suppressed_at(f, project.modules)]
+
+
+FLAG_DEF = """
+    import os
+
+    INTERPRET = os.environ.get("SYNTH_INTERPRET", "0") == "1"
+    LIMBS = 16  # plain constant: importing and reading this is fine
+"""
+
+FLAG_REEXPORT = """
+    from .flagdef import INTERPRET, LIMBS
+"""
+
+FLAG_READER = """
+    from drynx_tpu.crypto.reex import INTERPRET, LIMBS
+    import jax.experimental.pallas as pl
+
+    def builder(x):
+        return pl.pallas_call(_k, interpret=INTERPRET)(x)
+"""
+
+FLAG_PROJECT = [
+    ("drynx_tpu/crypto/flagdef.py", FLAG_DEF),
+    ("drynx_tpu/crypto/reex.py", FLAG_REEXPORT),
+    ("drynx_tpu/crypto/kern.py", FLAG_READER),
+]
+
+
+def test_cross_module_flag_fires_through_reexport_hop():
+    found = run_project(FLAG_PROJECT, "cross-module-flag-capture")
+    assert len(found) == 1
+    f = found[0]
+    assert "INTERPRET" in f.message and f.file == "drynx_tpu/crypto/kern.py"
+    # chain: read site -> import hops -> env-derived definition
+    assert f.call_chain[0].startswith("drynx_tpu/crypto/kern.py")
+    assert f.call_chain[-1].startswith("drynx_tpu/crypto/flagdef.py")
+    assert "os.environ" in f.message or "env" in f.call_chain[-1]
+
+
+def test_cross_module_flag_ignores_plain_constants():
+    reader = FLAG_READER.replace("interpret=INTERPRET", "interpret=bool(0)")
+    reader += ("\n    def other(x):\n"
+               "        return pl.pallas_call(_k, grid=LIMBS)(x)\n")
+    pairs = FLAG_PROJECT[:2] + [("drynx_tpu/crypto/kern.py", reader)]
+    assert run_project(pairs, "cross-module-flag-capture") == []
+
+
+def test_cross_module_flag_fires_on_module_alias_read():
+    pairs = [
+        ("drynx_tpu/crypto/__init__.py", ""),
+        ("drynx_tpu/crypto/flagdef.py", FLAG_DEF),
+        ("drynx_tpu/crypto/kern.py", """
+            from drynx_tpu.crypto import flagdef
+            import jax.experimental.pallas as pl
+
+            def builder(x):
+                return pl.pallas_call(
+                    _k, interpret=flagdef.INTERPRET)(x)
+        """),
+    ]
+    found = run_project(pairs, "cross-module-flag-capture")
+    assert len(found) == 1 and "flagdef.INTERPRET" in found[0].message
+
+
+def test_cross_module_flag_leaves_same_module_reads_to_per_module_rule():
+    src = """
+        import os
+        import jax
+
+        FLAG = os.environ.get("SYNTH_FLAG", "0") == "1"
+
+        @jax.jit
+        def f(x):
+            return x if FLAG else -x
+    """
+    pairs = [("drynx_tpu/crypto/solo.py", src)]
+    assert run_project(pairs, "cross-module-flag-capture") == []
+    assert len(run(src, rule="jit-global-capture")) == 1
+
+
+HOT_ENTRY = """
+    import jax
+
+    @jax.jit
+    def checksum(x):
+        return _acc(x)
+
+    def _acc(v):
+        return _fin(v + 1)
+
+    def _fin(v):
+        return float(v)
+"""
+
+
+def test_host_sync_fires_transitively_with_call_chain():
+    pairs = [("drynx_tpu/crypto/hot.py", HOT_ENTRY)]
+    found = run_project(pairs, "host-sync-in-hot-path")
+    assert len(found) == 1
+    f = found[0]
+    assert "float" in f.message and "checksum" in f.message
+    # entry -> _acc -> _fin -> float(): four rendered hops
+    assert len(f.call_chain) == 4
+    assert f.call_chain[0].endswith(":checksum")
+    assert f.call_chain[-1].endswith(":float()")
+    rendered = f.render()
+    assert "call chain:" in rendered and " -> " in rendered
+
+
+def test_host_sync_ignores_shape_metadata_in_helpers():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return _pad(x)
+
+        def _pad(v):
+            n = int(np.prod(v.shape[:2]))
+            return v.reshape(n)
+    """
+    assert run_project([("drynx_tpu/crypto/hot.py", src)],
+                       "host-sync-in-hot-path") == []
+
+
+def test_host_sync_noqa_at_sync_site_suppresses():
+    src = HOT_ENTRY.replace(
+        "return float(v)",
+        "return float(v)  # drynx: noqa[host-sync-in-hot-path]")
+    assert run_project([("drynx_tpu/crypto/hot.py", src)],
+                       "host-sync-in-hot-path") == []
+
+
+def test_host_sync_noqa_at_jit_entry_suppresses():
+    src = HOT_ENTRY.replace(
+        "def checksum(x):",
+        "def checksum(x):  # drynx: noqa[host-sync-in-hot-path]")
+    assert run_project([("drynx_tpu/crypto/hot.py", src)],
+                       "host-sync-in-hot-path") == []
+
+
+PALLAS_HEADER = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def _k(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+
+"""
+
+
+def test_pallas_dtype_flags_weak_operand():
+    src = PALLAS_HEADER + """
+    def bad(n):
+        weak = jnp.zeros((8, 128), jnp.float32)
+        return pl.pallas_call(_k, out_shape=None)(weak)
+"""
+    found = run_project([("drynx_tpu/crypto/pk.py", src)],
+                        "pallas-operand-dtype")
+    assert len(found) == 1 and "weak" in found[0].message
+    assert found[0].call_chain[0].endswith("pallas_call operand 0")
+
+
+def test_pallas_dtype_proves_pinning_helper_hop():
+    src = PALLAS_HEADER + """
+    def _pin(x):
+        return jnp.asarray(x, dtype=jnp.uint32)
+
+    def good(x):
+        return pl.pallas_call(_k, out_shape=None)(_pin(x))
+"""
+    assert run_project([("drynx_tpu/crypto/pk.py", src)],
+                       "pallas-operand-dtype") == []
+
+
+def test_pallas_dtype_proves_param_via_reverse_call_site_hop():
+    src = PALLAS_HEADER + """
+    def inner(pt):
+        return pl.pallas_call(_k, out_shape=None)(pt)
+
+    def outer(x):
+        return inner(jnp.asarray(x, jnp.uint32))
+"""
+    assert run_project([("drynx_tpu/crypto/pk.py", src)],
+                       "pallas-operand-dtype") == []
+
+
+def test_pallas_dtype_proves_tuple_unpack_and_preserving_chain():
+    src = PALLAS_HEADER + """
+    def _mk():
+        a = jnp.zeros((8, 128), jnp.uint32)
+        b = jnp.ones((8, 128), jnp.uint32)
+        return a, b
+
+    def both(n):
+        m, v = _mk()
+        return pl.pallas_call(_k, out_shape=None)(
+            m.reshape(8, 128), jnp.transpose(v))
+"""
+    assert run_project([("drynx_tpu/crypto/pk.py", src)],
+                       "pallas-operand-dtype") == []
+
+
+def test_pallas_dtype_flags_wrong_explicit_dtype():
+    src = PALLAS_HEADER + """
+    def bad(x):
+        return pl.pallas_call(_k, out_shape=None)(
+            jnp.asarray(x, jnp.int32))
+"""
+    found = run_project([("drynx_tpu/crypto/pk.py", src)],
+                        "pallas-operand-dtype")
+    assert len(found) == 1

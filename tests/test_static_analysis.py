@@ -9,22 +9,28 @@ Marked `lint`: `pytest -m lint` runs just this file in seconds. The
 analysis package deliberately imports no jax, so this test stays alive
 even when the accelerator stack is broken.
 """
+import json
 import subprocess
 import sys
+import time
 
 import pytest
 
 from drynx_tpu.analysis import (DEFAULT_BASELINE, REPO_ROOT, RULES,
-                                analyze_paths, apply_baseline, load_baseline)
+                                ProjectInfo, analyze_paths, analyze_project,
+                                apply_baseline, load_baseline)
 
 pytestmark = pytest.mark.lint
 
 PACKAGE = REPO_ROOT / "drynx_tpu"
+FIXTURE = REPO_ROOT / "tests" / "fixtures" / "lintpkg"
+GOLDEN_GRAPH = REPO_ROOT / "tests" / "fixtures" / "lintpkg_graph.json"
 
 
 def test_registry_has_the_documented_rules():
-    expected = {"jit-global-capture", "unsafe-pickle", "implicit-dtype",
-                "host-sync-in-hot-path", "env-read-into-trace",
+    expected = {"jit-global-capture", "cross-module-flag-capture",
+                "unsafe-pickle", "implicit-dtype", "host-sync-in-hot-path",
+                "pallas-operand-dtype", "env-read-into-trace",
                 "secret-logging", "hardcoded-timeout", "thread-trace"}
     assert expected <= set(RULES), sorted(expected - set(RULES))
 
@@ -81,3 +87,62 @@ def test_cli_passes_a_clean_file(tmp_path):
     ok.write_text("import numpy as np\n\nX = np.zeros((4,), np.uint32)\n")
     proc = _cli([str(ok)])
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- whole-program pass ------------------------------------------------------
+
+def test_project_pass_is_clean_and_fast():
+    # the acceptance budget: import graph + callgraph + all three project
+    # rules over the whole package, under five seconds, zero findings.
+    t0 = time.monotonic()
+    findings = analyze_project([PACKAGE])
+    elapsed = time.monotonic() - t0
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert elapsed < 5.0, f"project pass took {elapsed:.1f}s (budget 5s)"
+
+
+def test_list_rules_marks_project_rules():
+    proc = _cli(["--list-rules"])
+    assert proc.returncode == 0
+    assert "pallas-operand-dtype [project]:" in proc.stdout
+    assert "cross-module-flag-capture [project]:" in proc.stdout
+    assert "unsafe-pickle:" in proc.stdout  # per-module rules unmarked
+
+
+def test_fixture_package_yields_exactly_the_three_findings():
+    proc = _cli([str(FIXTURE), "--no-baseline"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = proc.stdout
+    for rule in ("cross-module-flag-capture", "host-sync-in-hot-path",
+                 "pallas-operand-dtype"):
+        assert out.count(f"[{rule}]") == 1, out
+    assert out.count("call chain:") == 3, out
+
+
+def test_json_format_has_stable_call_chain_field():
+    proc = _cli([str(FIXTURE), "--no-baseline", "--format", "json"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    findings = data["findings"]
+    assert len(findings) == 3
+    for f in findings:
+        assert isinstance(f["call_chain"], list) and f["call_chain"]
+        assert all(isinstance(h, str) for h in f["call_chain"])
+    sync = next(f for f in findings if f["rule"] == "host-sync-in-hot-path")
+    assert sync["call_chain"][0].endswith(":checksum")
+    assert sync["call_chain"][-1].endswith(":float()")
+
+
+def test_fixture_graphs_match_golden_json():
+    project, errors = ProjectInfo.from_paths([FIXTURE])
+    assert errors == []
+    golden = json.loads(GOLDEN_GRAPH.read_text(encoding="utf-8"))
+    assert project.to_json() == golden
+
+
+def test_changed_only_mode_runs():
+    # inside the repo git is available: either "no changed python files"
+    # (clean tree) or a per-module scan of the dirty set — both exit 0/1,
+    # never a usage error, and never the project pass.
+    proc = _cli(["--changed-only"])
+    assert proc.returncode in (0, 1), proc.stdout + proc.stderr
