@@ -9,9 +9,18 @@ does not put float64 on the TPU hot path.
 """
 import os
 
-import jax
+# Lint-only fast path: the static analyzer (python -m drynx_tpu.analysis)
+# is deliberately jax-free, but importing its parent package triggers
+# ~0.4s of accelerator setup below. DRYNX_SKIP_JAX_INIT=1 skips ALL of it
+# — only safe for processes that never execute jax code (the pre-commit
+# lint tier in scripts/check.sh sets it).
+if os.environ.get("DRYNX_SKIP_JAX_INIT", "0") == "1":
+    jax = None
+else:
+    import jax
 
-jax.config.update("jax_enable_x64", True)
+if jax is not None:
+    jax.config.update("jax_enable_x64", True)
 
 # Pin the backend from JAX_PLATFORMS HERE — before any crypto module's
 # import-time jnp op can initialize a backend. The env var alone is not
@@ -20,7 +29,7 @@ jax.config.update("jax_enable_x64", True)
 # the first dispatch even with JAX_PLATFORMS=cpu in the env. Pinning at
 # package import covers every entrypoint (CLI, scripts, tests).
 _plat = os.environ.get("JAX_PLATFORMS")
-if _plat:
+if _plat and jax is not None:
     jax.config.update("jax_platforms", _plat)
 
 # Persistent XLA compilation cache: OPT-IN via DRYNX_JAX_CACHE=<dir>.
@@ -30,7 +39,8 @@ if _plat:
 # instead keeps compiles rare by design: rolled limb loops (small graphs,
 # crypto/field.py) and per-bucket jits reused in-process (crypto/batching.py).
 _cache = os.environ.get("DRYNX_JAX_CACHE", "")
-if _cache and _cache != "off" and not jax.config.jax_compilation_cache_dir:
+if jax is not None and _cache and _cache != "off" \
+        and not jax.config.jax_compilation_cache_dir:
     os.makedirs(_cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -50,7 +60,7 @@ if _cache and _cache != "off" and not jax.config.jax_compilation_cache_dir:
 # every compile a clean, collision-free stack; the lock keeps them one at a
 # time. Compiles are rare and cached — the thread spawn is noise.
 # Kill-switch: DRYNX_NO_COMPILE_LOCK=1.
-if os.environ.get("DRYNX_NO_COMPILE_LOCK", "0") != "1":
+if jax is not None and os.environ.get("DRYNX_NO_COMPILE_LOCK", "0") != "1":
     try:
         import threading as _threading
 

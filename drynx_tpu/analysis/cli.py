@@ -52,7 +52,8 @@ def build_parser() -> argparse.ArgumentParser:
                          "anything (prune reminder)")
     ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
                     help="run only this rule (repeatable)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--list-rules", action="store_true")
     proj = ap.add_mutually_exclusive_group()
     proj.add_argument("--project", dest="project", action="store_true",
@@ -62,9 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
                       help="per-module rules only (no import graph / "
                            "callgraph)")
     ap.add_argument("--changed-only", action="store_true",
-                    help="lint only files changed vs git HEAD (falls back "
-                         "to the full default scan when git is unavailable);"
-                         " implies --no-project")
+                    help="fast tier: restrict reporting to the *impacted "
+                         "set* of the files changed vs git HEAD — the "
+                         "changed files plus their transitive importers "
+                         "via the reverse import graph (full scan fallback "
+                         "when git is unavailable)")
     return ap
 
 
@@ -95,12 +98,29 @@ def _changed_files() -> Optional[List[Path]]:
     return out
 
 
+def _relpath(p: Path) -> str:
+    try:
+        return p.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.resolve().as_posix()
+
+
+def _is_under(p: Path, root: Path) -> bool:
+    try:
+        p.resolve().relative_to(Path(root).resolve())
+        return True
+    except ValueError:
+        return False
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
         for rid, rule in sorted(RULES.items()):
             mark = " [project]" if rule.project else ""
+            if rule.seed_only:
+                mark += " [seed-only]"
             print(f"{rid}{mark}: {rule.summary}")
         return 0
 
@@ -111,8 +131,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     project_mode = args.project
+    changed_rel: Optional[List[str]] = None
     if args.changed_only:
         changed = _changed_files()
+        if changed is not None:
+            # the deliberately-broken lint fixtures must not redden the
+            # pre-commit tier when they themselves are edited
+            changed = [p for p in changed
+                       if "tests/fixtures" not in _relpath(p)]
         if changed is None:
             print("git unavailable; falling back to a full scan",
                   file=sys.stderr)
@@ -121,9 +147,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("no changed python files", file=sys.stderr)
             return 0
         else:
-            paths = changed
-            # a partial file set has no meaningful import graph
-            project_mode = False
+            # scan the whole package (graphs/summaries need it), but
+            # report only the impacted set: changed files + transitive
+            # importers via the reverse import graph. Changed files
+            # outside the package scan roots are linted too.
+            scan = args.paths or [REPO_ROOT / "drynx_tpu"]
+            paths = list(scan) + [p for p in changed
+                                  if not any(_is_under(p, s)
+                                             for s in scan)]
+            changed_rel = [_relpath(p) for p in changed]
+            if not project_mode:
+                paths = changed
     else:
         paths = args.paths or [REPO_ROOT / "drynx_tpu"]
     for p in paths:
@@ -132,13 +166,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     if project_mode:
-        findings = analyze_project(paths, rules=args.rules)
+        findings = analyze_project(paths, rules=args.rules,
+                                   changed=changed_rel)
     else:
         findings = analyze_paths(paths, rules=args.rules)
     baseline = [] if args.no_baseline else load_baseline(args.baseline)
     unbaselined, matched, stale = apply_baseline(findings, baseline)
 
-    if args.format == "json":
+    if args.format == "sarif":
+        from .sarif import to_sarif
+        print(json.dumps(to_sarif(unbaselined), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_json() for f in unbaselined],
             "baselined": matched,

@@ -77,6 +77,8 @@ class Rule:
     id: str = ""
     summary: str = ""
     project = False    # ProjectRule flips this; --list-rules marks it
+    seed_only = False  # kept as a seed list for a dataflow successor rule
+    absorbs: Tuple[str, ...] = ()  # rule ids this rule's findings dedupe
 
     def run(self, mod: "ModuleInfo") -> Iterator[Finding]:
         raise NotImplementedError
@@ -181,6 +183,7 @@ class ModuleInfo:
         self.source = source
         self.relpath = relpath
         self.lines = source.splitlines()
+        self.content_hash = hashlib.sha256(source.encode("utf-8")).hexdigest()
         self.tree = ast.parse(source)
         self._analyze()
 

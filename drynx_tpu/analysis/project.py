@@ -129,6 +129,49 @@ class ProjectInfo:
         return FlagOrigin(module=def_mod, relpath=info.relpath, name=def_name,
                           lineno=lineno, reason=reason, hops=tuple(hops))
 
+    def in_focus(self, relpath: str) -> bool:
+        """True when the file is inside the current focus set (or no
+        focus is active). ``--changed-only`` narrows the focus to the
+        impacted set; rules consult this to skip out-of-focus modules."""
+        focus = getattr(self, "focus", None)
+        return focus is None or relpath in focus
+
+    def impacted_relpaths(self, changed: Iterable[str]) -> Set[str]:
+        """The *impacted set* of a change: the changed files plus every
+        transitive importer (reverse import-graph closure) — a change to
+        params.py re-runs the project rules on everything that imports
+        it, directly or through re-exports."""
+        rev: Dict[str, Set[str]] = {}
+        for dotted, mg in self.graphs.items():
+            deps: Set[str] = set()
+            for b in mg.froms.values():
+                t = self.imports.canon(b.target_module)
+                if t is not None:
+                    deps.add(t)
+                    # `from pkg import mod` may bind a submodule
+                    sub = self.imports.canon(f"{t}.{b.target_name}")
+                    if sub is not None:
+                        deps.add(sub)
+            for a in mg.aliases.values():
+                t = self.imports.canon(a.target_module)
+                if t is not None:
+                    deps.add(t)
+            for dep in deps:
+                rev.setdefault(dep, set()).add(dotted)
+        by_rel = {mg.info.relpath: dotted
+                  for dotted, mg in self.graphs.items()}
+        queue = [by_rel[rel] for rel in changed if rel in by_rel]
+        seen: Set[str] = set(queue)
+        while queue:
+            cur = queue.pop()
+            for importer in rev.get(cur, ()):
+                if importer not in seen:
+                    seen.add(importer)
+                    queue.append(importer)
+        out = {self.graphs[d].info.relpath for d in seen}
+        out.update(rel for rel in changed if rel in self.modules)
+        return out
+
     # -- golden-test shape -------------------------------------------------
 
     def to_json(self) -> Dict[str, object]:
@@ -173,22 +216,50 @@ def chain_hop(relpath: str, lineno: int, symbol: str) -> str:
 
 def analyze_project(paths: Sequence[Path],
                     rules: Optional[Iterable[str]] = None,
+                    changed: Optional[Sequence[str]] = None,
                     ) -> List[Finding]:
     """Whole-program pass: per-module rules on every file + project rules
-    over the ProjectInfo, noqa applied at the finding line or any anchor."""
+    over the ProjectInfo, noqa applied at the finding line or any anchor.
+
+    ``changed`` (relpaths) narrows the pass to the *impacted set* — the
+    changed files plus their transitive importers via the reverse import
+    graph. The graphs and summaries are still built whole-program (a
+    partial file set has no meaningful import graph); only reporting and
+    the per-module scan are restricted, which is what keeps
+    ``--changed-only`` under the fast-tier budget.
+    """
     from . import rules as _rules  # noqa: F401  (side effect: registration)
 
     project, findings = ProjectInfo.from_paths(paths)
+    focus: Optional[Set[str]] = None
+    if changed is not None:
+        focus = project.impacted_relpaths(changed)
+        project.focus = focus
+        findings = [f for f in findings if f.file in focus]
     selected = list(RULES.values() if rules is None
                     else [RULES[r] for r in rules])
     for relpath in sorted(project.modules):
+        if focus is not None and relpath not in focus:
+            continue
         mod = project.modules[relpath]
         for rule in selected:
             findings.extend(rule.run(mod))
     for rule in selected:
         if rule.project:
-            findings.extend(rule.run_project(project))
+            found = rule.run_project(project)
+            findings.extend(f for f in found
+                            if focus is None or f.file in focus)
     findings = [f for f in findings
                 if not suppressed_at(f, project.modules)]
+    # absorb: a rule may declare it supersedes another's findings at the
+    # same (file, line) — the dataflow secret-flow rule wins over the
+    # regex seed rule so one leak is reported once.
+    winners: Dict[str, Set[Tuple[str, int]]] = {}
+    for f in findings:
+        rule = RULES.get(f.rule)
+        for victim in getattr(rule, "absorbs", ()):
+            winners.setdefault(victim, set()).add((f.file, f.line))
+    findings = [f for f in findings
+                if (f.file, f.line) not in winners.get(f.rule, set())]
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings

@@ -392,9 +392,15 @@ class SecretLogging(Rule):
     a log stream or stdout: logs cross trust boundaries (CI artifacts,
     shared hosts) that the ciphertexts are specifically protecting the data
     from. Flags print()/log.*/logging calls whose arguments reference a
-    secret-shaped identifier."""
+    secret-shaped identifier.
+
+    Kept as the *seed list* for the dataflow successor
+    ``secret-flow-to-sink`` (which tracks actual values from keygen/nonce
+    definition sites instead of matching names): where both fire on the
+    same line, the dataflow finding wins and this one is absorbed."""
 
     id = "secret-logging"
+    seed_only = True
     summary = "print/log call referencing secret-key material"
 
     def run(self, mod: ModuleInfo) -> Iterator[Finding]:
@@ -654,7 +660,8 @@ class CrossModuleFlagCapture(ProjectRule):
         for dotted in sorted(project.graphs):
             mg = project.graphs[dotted]
             info = mg.info
-            if not info.traced_functions:
+            if not info.traced_functions or not project.in_focus(
+                    info.relpath):
                 continue
             for fn in info.traced_functions:
                 local = _local_bindings(fn)
@@ -753,7 +760,8 @@ class PallasOperandDtype(ProjectRule):
             mg = project.graphs[dotted]
             info = mg.info
             if not (_is_drynx_pkg(info)
-                    and _in_scope(info, "crypto", "parallel")):
+                    and _in_scope(info, "crypto", "parallel")
+                    and project.in_focus(info.relpath)):
                 continue
             for qual in sorted(mg.functions):
                 fn = mg.functions[qual]
@@ -1046,3 +1054,73 @@ class PallasOperandDtype(ProjectRule):
         shim = FuncNode(mg.dotted, "<module>",
                         ast.parse("def _m():\n    pass").body[0])
         return self._prove(project, shim, expr, trail, depth, visiting)
+
+
+# ---------------------------------------------------------------------------
+# Value-level dataflow rules (drynx_tpu/analysis/dataflow.py): both are
+# thin wrappers over one shared engine run — dataflow_for() memoizes on a
+# content-hash fingerprint of the whole project, so the abstract
+# interpreter executes once per tree version no matter how many rules (or
+# repeated analyze_project calls) consume it.
+
+def _raw_to_finding(rule_id: str, project: ProjectInfo, raw) -> Finding:
+    mod = project.modules.get(raw.file)
+    return Finding(rule=rule_id, file=raw.file, line=raw.line,
+                   message=raw.message,
+                   line_text=mod.line_text(raw.line) if mod else "",
+                   call_chain=raw.chain, anchors=raw.anchors)
+
+
+@register
+class CiphertextDtypeLaunder(ProjectRule):
+    """A ciphertext limb array that was provably uint32 loses the dtype
+    (``astype(float32)``, float-constant arithmetic, true division —
+    often hidden inside a pytree flatten/transform/unflatten round trip)
+    and then reaches a pallas/jit kernel or a serialization point. The
+    kernels compute exact Montgomery limb arithmetic: one weak promotion
+    silently corrupts carries and changes the proof transcript. The
+    finding renders the whole value-flow chain (pin site, laundering hop,
+    sink) and is suppressible at any hop; re-pinning with
+    ``jnp.asarray(..., jnp.uint32)`` at the boundary clears the taint,
+    and ``# drynx: declassify[dtype]`` marks deliberate byte-packing."""
+
+    id = "ciphertext-dtype-launder"
+    summary = ("uint32 limb value reaches a pallas/jit kernel or "
+               "serialization after a dtype-laundering hop (value "
+               "dataflow)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .dataflow import dataflow_for
+        df = dataflow_for(project, getattr(project, "focus", None))
+        for raw in df.dtype_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class SecretFlowToSink(ProjectRule):
+    """The dataflow successor to the regex ``secret-logging`` rule:
+    secrecy is seeded at *definition sites* — ``keygen()`` (ElGamal
+    secret), ``secrets.randbelow()`` (Schnorr nonce), DP cleartext loads —
+    and propagated per value through assignments, tuples, dataclass
+    fields, f-strings and interprocedural summaries. It fires when a
+    secret value reaches ``print``/``log.*``/TOML-or-serialized
+    output/exception messages/transport ``send`` calls, with the full
+    value-flow chain rendered. Where the regex rule flags the same line,
+    this finding absorbs it (one leak, one report). Deliberate key-store
+    writes are ``noqa``'d with a reason; protocol outputs that are public
+    by construction are marked ``# drynx: declassify[secret]`` at the
+    defining assignment."""
+
+    id = "secret-flow-to-sink"
+    summary = ("secret value (keygen/nonce/DP cleartext) reaches a "
+               "log/print/serialization/exception/send sink (value "
+               "dataflow)")
+    absorbs = ("secret-logging",)
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .dataflow import dataflow_for
+        df = dataflow_for(project, getattr(project, "focus", None))
+        for raw in df.secret_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)

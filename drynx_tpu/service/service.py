@@ -491,7 +491,10 @@ class LocalCluster:
         dp_idents = list(self.dp_idents)
         absent: list[str] = []
         if plan is not None:
-            absent = [d.name for d in dp_idents if plan.killed(d.name)]
+            # DP names are public routing metadata even though the
+            # identity objects also carry the node's secret scalar
+            absent = [d.name  # drynx: declassify[secret]
+                      for d in dp_idents if plan.killed(d.name)]
             dp_idents = [d for d in dp_idents if d.name not in absent]
         responders = [d.name for d in dp_idents]
         need = (sq.min_dp_quorum if sq.min_dp_quorum > 0

@@ -4,11 +4,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== changed-files lint (fast tier, per-module rules) =="
-python -m drynx_tpu.analysis --changed-only
+echo "== changed-files lint (fast tier: impacted set = changed files +"
+echo "== transitive importers; DRYNX_SKIP_JAX_INIT skips accelerator setup"
+echo "== in the jax-free lint process, <2s for a leaf-file change) =="
+DRYNX_SKIP_JAX_INIT=1 python -m drynx_tpu.analysis --changed-only
 
 echo "== static analysis (python -m drynx_tpu.analysis, whole-program) =="
-python -m drynx_tpu.analysis drynx_tpu/ "$@"
+DRYNX_SKIP_JAX_INIT=1 python -m drynx_tpu.analysis drynx_tpu/ "$@"
+
+echo "== sarif rendering smoke (codeFlows for CI annotation) =="
+DRYNX_SKIP_JAX_INIT=1 python -m drynx_tpu.analysis tests/fixtures/lintpkg \
+    --no-baseline --format sarif > /dev/null || test $? -eq 1
+
+echo "== dataflow + sarif unit tests =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly tests/test_dataflow.py
 
 echo "== precompile registry smoke (trace+lower the proofs-on program set) =="
 JAX_PLATFORMS=cpu python -m drynx_tpu.precompile --dry-run --quiet

@@ -1,0 +1,55 @@
+"""Value-dataflow fixture: exactly TWO violations, one per dataflow rule.
+
+* ``launder_roundtrip`` — a uint32 limb array is pinned, flattened
+  through a pytree, laundered to float32 in the transform, repacked and
+  fed to a jit kernel: one ``ciphertext-dtype-launder``.
+* ``announce`` — a ``secrets.randbelow`` nonce flows into ``log.info``:
+  one ``secret-flow-to-sink``. The identifier is deliberately ``sk`` so
+  the regex ``secret-logging`` seed rule fires on the same line — the
+  dedupe test asserts the dataflow finding absorbs it (one report).
+
+The ``*_ok`` twins are the negative cases: re-pinning the dtype at the
+pytree boundary clears the launder taint, and logging only the public
+survey id is fine.
+"""
+import logging
+import secrets
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("lintpkg.dataflow")
+
+
+@jax.jit
+def _kernel(x):
+    return x + 1
+
+
+def launder_roundtrip(ct):
+    ct = jnp.asarray(ct, dtype=jnp.uint32)
+    leaves, treedef = jax.tree.flatten({"body": ct})
+    leaves = [leaf.astype(jnp.float32) for leaf in leaves]   # launder!
+    repacked = jax.tree.unflatten(treedef, leaves)
+    return _kernel(repacked)
+
+
+def launder_roundtrip_ok(ct):
+    ct = jnp.asarray(ct, dtype=jnp.uint32)
+    leaves, treedef = jax.tree.flatten({"body": ct})
+    leaves = [leaf.astype(jnp.float32) for leaf in leaves]
+    leaves = [jnp.asarray(leaf, dtype=jnp.uint32) for leaf in leaves]
+    repacked = jax.tree.unflatten(treedef, leaves)
+    return _kernel(repacked)
+
+
+def announce(survey_id):
+    sk = secrets.randbelow(1 << 16)
+    log.info("survey %s nonce %d", survey_id, sk)
+    return sk
+
+
+def announce_ok(survey_id):
+    sk = secrets.randbelow(1 << 16)
+    log.info("survey %s started", survey_id)
+    return sk

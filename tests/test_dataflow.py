@@ -1,0 +1,273 @@
+"""Unit tests for the value-dataflow engine (drynx_tpu.analysis.dataflow).
+
+Lattice transfer functions are exercised on tiny synthetic projects built
+with ProjectInfo.from_sources; the fixture package pins goldens for the
+interprocedural summaries and the SARIF rendering; the dedupe test proves
+the dataflow successor absorbs the regex secret-logging seed rule.
+
+Marked `lint` alongside test_static_analysis.py: pure ast, no jax import.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from drynx_tpu.analysis import REPO_ROOT, ProjectInfo
+from drynx_tpu.analysis.dataflow import DT_UINT32, Dataflow, dataflow_for
+
+pytestmark = pytest.mark.lint
+
+FIXTURE = REPO_ROOT / "tests" / "fixtures" / "lintpkg"
+GOLDEN_SUMMARIES = REPO_ROOT / "tests" / "fixtures" / "lintpkg_dataflow.json"
+GOLDEN_SARIF = REPO_ROOT / "tests" / "fixtures" / "lintpkg_sarif.json"
+
+CRYPTO = "drynx_tpu/crypto/flow.py"
+SERVICE = "drynx_tpu/service/flow.py"
+
+
+def build(pairs):
+    project = ProjectInfo.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in pairs])
+    df = Dataflow(project)
+    df.run()
+    return project, df
+
+
+def summary(df, fid):
+    got = df.summaries.get(fid)
+    assert got is not None, sorted(df.summaries)
+    return got
+
+
+# -- dtype lattice -----------------------------------------------------------
+
+LAUNDER = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kern(x):
+        return x + 1
+
+    def bad(ct):
+        ct = jnp.asarray(ct, dtype=jnp.uint32)
+        ct = ct.astype(jnp.float32)
+        return kern(ct)
+
+    def good(ct):
+        ct = jnp.asarray(ct, dtype=jnp.uint32)
+        return kern(ct)
+
+    def repinned(ct):
+        ct = jnp.asarray(ct, dtype=jnp.uint32)
+        ct = ct.astype(jnp.float32)
+        ct = ct.astype(jnp.uint32)
+        return kern(ct)
+"""
+
+
+def test_astype_launders_and_jit_sink_fires():
+    _, df = build([(CRYPTO, LAUNDER)])
+    lines = [r.line for r in df.dtype_raw]
+    # only bad()'s kern(ct) call: line 13 of the dedented source
+    assert len(lines) == 1, df.dtype_raw
+    raw = df.dtype_raw[0]
+    assert "kern" in raw.message and "laundered" in raw.message
+    assert any(".astype(" in hop for hop in raw.chain), raw.chain
+
+
+def test_astype_uint32_repins_and_clears_the_launder():
+    _, df = build([(CRYPTO, LAUNDER)])
+    s = summary(df, "drynx_tpu.crypto.flow:repinned")
+    assert s.ret.dtype == DT_UINT32
+    assert not s.ret.laundered
+
+
+PYTREE = """
+    import jax
+    import jax.numpy as jnp
+
+    def roundtrip(ct):
+        ct = jnp.asarray(ct, dtype=jnp.uint32)
+        leaves, treedef = jax.tree.flatten({"body": ct})
+        return jax.tree.unflatten(treedef, leaves)
+
+    def transformed(ct):
+        ct = jnp.asarray(ct, dtype=jnp.uint32)
+        leaves, treedef = jax.tree.flatten({"body": ct})
+        leaves = [leaf / 2 for leaf in leaves]
+        return jax.tree.unflatten(treedef, leaves)
+"""
+
+
+def test_pytree_roundtrip_preserves_the_pin():
+    _, df = build([(CRYPTO, PYTREE)])
+    s = summary(df, "drynx_tpu.crypto.flow:roundtrip")
+    assert s.ret.dtype == DT_UINT32 and not s.ret.laundered
+
+
+def test_true_division_launders_through_the_pytree():
+    _, df = build([(CRYPTO, PYTREE)])
+    s = summary(df, "drynx_tpu.crypto.flow:transformed")
+    assert s.ret.laundered
+    assert any("division" in hop for hop in s.ret.dtype_chain)
+
+
+DATACLASS = """
+    import dataclasses
+    import jax.numpy as jnp
+
+    @dataclasses.dataclass
+    class Limbs:
+        body: object
+        tag: int
+
+    def mk(x):
+        x = jnp.asarray(x, dtype=jnp.uint32)
+        return Limbs(x, 3)
+
+    def body_of(x):
+        return mk(x).body
+"""
+
+
+def test_dataclass_fields_carry_the_dtype_through_summaries():
+    _, df = build([(CRYPTO, DATACLASS)])
+    s = summary(df, "drynx_tpu.crypto.flow:body_of")
+    assert s.ret.dtype == DT_UINT32
+
+
+# -- secrecy lattice ---------------------------------------------------------
+
+SECRETS = """
+    import secrets
+
+    def leak():
+        k = secrets.randbelow(100)
+        print(k)
+
+    def redacted():
+        k = secrets.randbelow(100)
+        print(hash(k))
+
+    def declassified():
+        k = secrets.randbelow(100)
+        s = k % 7  # drynx: declassify[secret]
+        print(s)
+"""
+
+
+def test_nonce_seed_reaches_print_sink():
+    _, df = build([(SERVICE, SECRETS)])
+    assert len(df.secret_raw) == 1, df.secret_raw
+    raw = df.secret_raw[0]
+    assert "nonce draw" in raw.chain[0]
+    assert "print()" in raw.chain[-1]
+
+
+def test_hash_and_declassify_marker_scrub_secrecy():
+    # the one finding sits in leak() (line 6 of the dedented source):
+    # hash() redaction and the declassify marker both scrub the taint, so
+    # redacted() and declassified() contribute nothing
+    _, df = build([(SERVICE, SECRETS)])
+    assert [r.line for r in df.secret_raw] == [6]
+
+
+INTERPROC = """
+    import secrets
+
+    def emit(payload):
+        print(payload)
+
+    def caller():
+        k = secrets.randbelow(100)
+        emit(k)
+"""
+
+
+def test_param_sink_summary_fires_at_the_call_site():
+    _, df = build([(SERVICE, INTERPROC)])
+    s = summary(df, "drynx_tpu.service.flow:emit")
+    assert [(ps.param, ps.kind) for ps in s.sinks] == [(0, "secret")]
+    assert len(df.secret_raw) == 1
+    raw = df.secret_raw[0]
+    assert "emit" in raw.message
+    # chain: seed -> call hop -> sink inside the callee
+    assert "nonce draw" in raw.chain[0]
+    assert "print()" in raw.chain[-1]
+
+
+# -- caching -----------------------------------------------------------------
+
+def test_dataflow_for_is_memoized_per_content_fingerprint():
+    project, _ = ProjectInfo.from_paths([FIXTURE])
+    df1 = dataflow_for(project)
+    # a *different* ProjectInfo over the same sources hits the same entry
+    project2, _ = ProjectInfo.from_paths([FIXTURE])
+    df2 = dataflow_for(project2)
+    assert df1 is df2
+    assert df1.runs == 1
+
+
+# -- goldens over the fixture package ---------------------------------------
+
+def test_fixture_summaries_match_golden():
+    project, errors = ProjectInfo.from_paths([FIXTURE])
+    assert errors == []
+    df = dataflow_for(project)
+    golden = json.loads(GOLDEN_SUMMARIES.read_text(encoding="utf-8"))
+    assert df.summaries_json("tests.fixtures.lintpkg.dataflow") == golden
+
+
+def _cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def test_fixture_sarif_matches_golden():
+    proc = _cli([str(FIXTURE), "--no-baseline", "--format", "sarif"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    got = json.loads(proc.stdout)
+    golden = json.loads(GOLDEN_SARIF.read_text(encoding="utf-8"))
+    assert got == golden
+    flows = [r for r in got["runs"][0]["results"] if r.get("codeFlows")]
+    assert len(flows) == len(got["runs"][0]["results"])
+
+
+def test_dataflow_finding_absorbs_regex_secret_logging():
+    proc = _cli([str(FIXTURE), "--no-baseline"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert proc.stdout.count("[secret-flow-to-sink]") == 1
+    assert "[secret-logging]" not in proc.stdout
+    # the seed rule is still alive on its own (regression guard for the
+    # absorb mechanism, not a tautology)
+    alone = _cli([str(FIXTURE), "--no-baseline", "--rule", "secret-logging"])
+    assert alone.stdout.count("[secret-logging]") == 1
+
+
+# -- impacted set (--changed-only) ------------------------------------------
+
+CHAIN_A = """
+    VALUE = 1
+"""
+CHAIN_B = """
+    from drynx_tpu.crypto.aa import VALUE
+"""
+CHAIN_C = """
+    from drynx_tpu.crypto.bb import VALUE
+"""
+
+
+def test_impacted_relpaths_walks_the_reverse_import_graph():
+    project, _ = build([("drynx_tpu/crypto/aa.py", CHAIN_A),
+                        ("drynx_tpu/crypto/bb.py", CHAIN_B),
+                        ("drynx_tpu/crypto/cc.py", CHAIN_C)])
+    impacted = project.impacted_relpaths(["drynx_tpu/crypto/aa.py"])
+    assert impacted == {"drynx_tpu/crypto/aa.py", "drynx_tpu/crypto/bb.py",
+                       "drynx_tpu/crypto/cc.py"}
+    # a leaf change impacts only itself
+    assert project.impacted_relpaths(["drynx_tpu/crypto/cc.py"]) == {
+        "drynx_tpu/crypto/cc.py"}

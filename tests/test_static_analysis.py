@@ -31,7 +31,8 @@ def test_registry_has_the_documented_rules():
     expected = {"jit-global-capture", "cross-module-flag-capture",
                 "unsafe-pickle", "implicit-dtype", "host-sync-in-hot-path",
                 "pallas-operand-dtype", "env-read-into-trace",
-                "secret-logging", "hardcoded-timeout", "thread-trace"}
+                "secret-logging", "hardcoded-timeout", "thread-trace",
+                "ciphertext-dtype-launder", "secret-flow-to-sink"}
     assert expected <= set(RULES), sorted(expected - set(RULES))
 
 
@@ -109,14 +110,15 @@ def test_list_rules_marks_project_rules():
     assert "unsafe-pickle:" in proc.stdout  # per-module rules unmarked
 
 
-def test_fixture_package_yields_exactly_the_three_findings():
+def test_fixture_package_yields_exactly_the_five_findings():
     proc = _cli([str(FIXTURE), "--no-baseline"])
     assert proc.returncode == 1, proc.stdout + proc.stderr
     out = proc.stdout
     for rule in ("cross-module-flag-capture", "host-sync-in-hot-path",
-                 "pallas-operand-dtype"):
+                 "pallas-operand-dtype", "ciphertext-dtype-launder",
+                 "secret-flow-to-sink"):
         assert out.count(f"[{rule}]") == 1, out
-    assert out.count("call chain:") == 3, out
+    assert out.count("call chain:") == 5, out
 
 
 def test_json_format_has_stable_call_chain_field():
@@ -124,7 +126,7 @@ def test_json_format_has_stable_call_chain_field():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     findings = data["findings"]
-    assert len(findings) == 3
+    assert len(findings) == 5
     for f in findings:
         assert isinstance(f["call_chain"], list) and f["call_chain"]
         assert all(isinstance(h, str) for h in f["call_chain"])
@@ -142,7 +144,8 @@ def test_fixture_graphs_match_golden_json():
 
 def test_changed_only_mode_runs():
     # inside the repo git is available: either "no changed python files"
-    # (clean tree) or a per-module scan of the dirty set — both exit 0/1,
-    # never a usage error, and never the project pass.
+    # (clean tree) or a whole-package scan reported only over the
+    # *impacted set* (changed files + transitive importers) — both exit
+    # 0/1, never a usage error.
     proc = _cli(["--changed-only"])
     assert proc.returncode in (0, 1), proc.stdout + proc.stderr
