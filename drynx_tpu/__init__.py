@@ -38,6 +38,11 @@ if _plat and jax is not None:
 # cache (crash in compilation_cache.get_executable_and_time). The framework
 # instead keeps compiles rare by design: rolled limb loops (small graphs,
 # crypto/field.py) and per-bucket jits reused in-process (crypto/batching.py).
+# bench.py no longer assumes either way: its supervisor PROBES the
+# round-trip in throwaway children (write pass + deserialize pass,
+# bench.py probe_persistent_cache) and sets this env var for the measured
+# child only on an "ok" verdict; the verdict lands in the bench record as
+# `persistent_cache_probe`.
 _cache = os.environ.get("DRYNX_JAX_CACHE", "")
 if jax is not None and _cache and _cache != "off" \
         and not jax.config.jax_compilation_cache_dir:

@@ -53,6 +53,8 @@ class Profile:
     u: int = 16             # range-proof base
     l: int = 5              # range-proof digits
     dlog_limit: int = 10000
+    n_shards: int = 1       # proof-plane shards (parallel/proof_plane.py);
+                            # >1 adds the per-shard program set
 
 
 BENCH = Profile()
@@ -285,6 +287,65 @@ _B_SCHEMAS: list = [
      "RangeProofCreate", "pallas"),
 ]
 
+def _shard_schemas(p: Profile) -> list:
+    """The per-shard program set of the mesh proof plane — the SAME bucketed
+    ops as the full-batch schemas, at the smaller per-shard batch sizes the
+    chunked dispatch hits (parallel/proof_mesh.rlc_total_shards slices the
+    flat ns*V*l digit batch; proofs/range_proof._commit_kernel_sharded
+    slices the dp-flattened value axis V = n_dps*n_values). Empty when the
+    profile is single-shard, so single-device registries are a subset of
+    sharded ones (test_precompile.py enforces both directions)."""
+    if p.n_shards <= 1:
+        return []
+
+    def cdiv(a, k):
+        return -(-a // k)
+
+    # verify shard: slice of the flattened ns*V*l joint digit batch
+    vs = lambda p: cdiv(p.n_cns * p.n_dps * p.n_values * p.l, p.n_shards)
+    # creation shard: slice of the dp-flattened value axis
+    cs = lambda p: cdiv(p.n_dps * p.n_values, p.n_shards)
+    csl = lambda p: cs(p) * p.l
+    ncsl = lambda p: p.n_cns * cs(p) * p.l
+    return [
+        # --- rlc_total_shards per-shard body ---
+        ("miller", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+         [vs], "RangeProofVerifyShard", "pairing"),
+        ("gt_pow64", lambda p, b: (_gt(b), _scalar(b)),
+         [vs], "RangeProofVerifyShard", "pairing"),
+        # --- _commit_kernel per-shard body (D / V_pts / a stages) ---
+        ("fn_add", lambda p, b: (_scalar(b), _scalar(b)),
+         [cs], "RangeProofCreateShard", "device"),
+        ("fn_neg", lambda p, b: (_scalar(b),),
+         [csl, ncsl], "RangeProofCreateShard", "device"),
+        ("fn_mul_plain", lambda p, b: (_scalar(b), _scalar(b)),
+         [ncsl], "RangeProofCreateShard", "device"),
+        ("fn_mont_mul", lambda p, b: (_scalar(b), _scalar(b)),
+         [csl], "RangeProofCreateShard", "device"),
+        ("fixed_base_mul", lambda p, b: (_fb_table(), _scalar(b)),
+         [cs, csl], "RangeProofCreateShard", "g1"),
+        ("g1_add", lambda p, b: (_g1(b), _g1(b)),
+         [cs], "RangeProofCreateShard", "g1"),
+        ("g1_normalize", lambda p, b: (_g1(b),),
+         [csl], "RangeProofCreateShard", "g1"),
+        ("g2_scalar_mul", lambda p, b: (_g2(b), _scalar(b)),
+         [ncsl], "RangeProofCreateShard", "g1"),
+        ("g2_normalize", lambda p, b: (_g2(b),),
+         [ncsl], "RangeProofCreateShard", "g1"),
+        ("pair", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+         [ncsl], "RangeProofCreateShard", "pairing"),
+        ("gt_pow", lambda p, b: (_gt(b), _scalar(b)),
+         [ncsl], "RangeProofCreateShard", "pairing"),
+        ("gt_mul", lambda p, b: (_gt(b), _gt(b)),
+         [ncsl], "RangeProofCreateShard", "pairing"),
+        ("gt_pow_fixed_multi",
+         lambda p, b: (_pow_tables(p), _z((b,), "int32"), _scalar(b)),
+         [ncsl], "RangeProofCreateShard", "pallas"),
+        ("gt_pow_gtb", lambda p, b: (_scalar(b),),
+         [csl], "RangeProofCreateShard", "pallas"),
+    ]
+
+
 # Raw Pallas flat entry points the bucketed family dispatches internally on
 # TPU. Registered explicitly so their Mosaic compiles land in the
 # persistent cache even for call sites outside bucketed wrappers
@@ -403,7 +464,8 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     rp.aot_register_bucketed(build_gtb_table=_pallas_on())
 
     specs: dict[str, ProgramSpec] = {}
-    for op, args_fn, batches, phase, gate in _B_SCHEMAS:
+    for op, args_fn, batches, phase, gate in (
+            _B_SCHEMAS + _shard_schemas(profile)):
         w = B.BUCKETED_OPS.get(op)
         for bexpr in batches:
             batch = int(bexpr(profile))

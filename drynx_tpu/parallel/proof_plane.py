@@ -1,0 +1,182 @@
+"""The mesh proof plane: device-sharding policy for proof creation and
+joint-range verification.
+
+Whenever >= 2 devices are visible, the proof pipeline's two flat-batch
+hot paths run SHARDED by default:
+
+  * creation — the `dp` axis: each shard of the all-DP digit batch builds
+    its `a_ij` GT-table exponentiations locally (proofs/range_proof.py
+    `_commit_kernel_sharded`), gathered once per batch before the
+    Fiat-Shamir hash;
+  * verification — the `vn` axis: each VN-role shard verifies a slice of
+    the joint RLC digit batch (parallel/proof_mesh.py `rlc_total_shards`),
+    partial GT products combined with one log-tree GT multiplication.
+
+Execution strategy (why this is NOT shard_map): the per-shard work is the
+SAME single-device bucketed program set (crypto/batching.py) dispatched
+once per shard, so the plane reuses executables the compilecache registry
+already covers (at the smaller per-shard buckets — registry._shard_schemas)
+instead of minting one giant SPMD program. The monolithic shard_map path
+exceeded 90 minutes of XLA CPU compile (tests/test_proof_mesh.py history)
+because a shard_map body must stay traceable and therefore cannot take the
+host-oracle detour; per-shard dispatch keeps every backend's normal
+routing. On an accelerator mesh each shard's inputs are device_put onto
+its own device and JAX's async dispatch overlaps the shards; on CPU the
+shards execute through the host-native backend sequentially (placement is
+skipped — host detours ignore placement, and committed-device mixing
+would break the small XLA fn_* programs), so the fake 8-device mesh
+exercises sharding SEMANTICS, not speedup. Per-value independence of the
+range-proof transcripts makes every sharded result bit-identical to the
+single-device path (exact mod-p arithmetic is associative), so the
+accept/reject decision cannot drift — tests/test_proof_mesh.py asserts
+byte equality.
+
+Policy env DRYNX_PROOF_PLANE: "auto" (default — shard over all visible
+devices when >= 2), "off" (single-device everywhere), or an integer shard
+count override.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..utils.timers import PhaseTimers
+
+ENV_FLAG = "DRYNX_PROOF_PLANE"
+
+# Batches smaller than this never shard: the per-shard dispatch overhead
+# (host_dispatch flatten + jit cache lookup per shard) would exceed the
+# per-element work of a handful of digit proofs.
+MIN_ITEMS_PER_SHARD = 1
+
+# Per-shard phase spans ("<Phase>.shard<i>"), folded into the bench
+# supervisor record (bench.py) — the observability analogue of the
+# per-program CompileStats rows.
+SHARD_TIMERS = PhaseTimers()
+
+
+def _policy() -> str:
+    return os.environ.get(ENV_FLAG, "auto").strip().lower()
+
+
+def device_count() -> int:
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:
+        return 1
+
+
+def n_shards() -> int:
+    """Shard count the plane runs at: visible devices under "auto", a
+    forced count under an integer policy, 1 under "off"."""
+    pol = _policy()
+    if pol in ("off", "0", "none", "single"):
+        return 1
+    if pol not in ("", "auto", "on"):
+        try:
+            return max(1, int(pol))
+        except ValueError:
+            pass
+    return max(1, device_count())
+
+
+def enabled() -> bool:
+    """True iff sharded creation/verification is the default path."""
+    return n_shards() >= 2
+
+
+def placement_on() -> bool:
+    """True iff shards are device_put onto distinct mesh devices: only on
+    the Pallas (accelerator) backend with a real multi-device mesh. On CPU
+    the heavy per-shard families detour to the host backend (placement is
+    meaningless) while the small XLA helpers would error on mixed
+    committed devices."""
+    from ..crypto import pallas_ops as po
+
+    return po.available() and device_count() >= 2
+
+
+def shard_device(i: int):
+    import jax
+
+    devs = jax.devices()
+    return devs[i % len(devs)]
+
+
+def put_shard(tree, i: int):
+    """Place one shard's arrays on mesh device i (identity off-mesh)."""
+    if not placement_on():
+        return tree
+    import jax
+
+    return jax.device_put(tree, shard_device(i))
+
+
+def gather(tree):
+    """Bring per-shard results back to the lead device for the combine /
+    concat ("results gathered once per batch")."""
+    if not placement_on():
+        return tree
+    import jax
+
+    return jax.device_put(tree, shard_device(0))
+
+
+def shard_slices(n: int, k: int,
+                 min_items: int = MIN_ITEMS_PER_SHARD) -> list:
+    """Balanced contiguous [start, stop) slices of range(n) over <= k
+    shards; never emits an empty shard, never splits below min_items."""
+    n, k = int(n), int(k)
+    if n <= 0:
+        return []
+    k = max(1, min(k, n // max(1, min_items)) or 1)
+    base, extra = divmod(n, k)
+    out, start = [], 0
+    for i in range(k):
+        stop = start + base + (1 if i < extra else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def record_shard(phase: str, i: int, seconds: float) -> None:
+    SHARD_TIMERS.add(f"{phase}.shard{i}", seconds)
+
+
+def timers_snapshot() -> dict:
+    """{"<Phase>.shard<i>": seconds} accumulated this process."""
+    return {k: round(v, 6) for k, v in SHARD_TIMERS.items()}
+
+
+def dispatch_shards(phase: str, fn, shard_args: list) -> list:
+    """Dispatch fn(i, *args_i) for every shard, then block in order.
+
+    On an accelerator mesh the dispatches are asynchronous, so shard i+1
+    enqueues while shard i computes — the devices overlap; the recorded
+    per-shard span is dispatch-start -> outputs-ready (on CPU this is the
+    shard's synchronous compute time). Results are gathered to the lead
+    device."""
+    import jax
+
+    outs, t0s = [], []
+    for i, args in enumerate(shard_args):
+        t0s.append(time.perf_counter())
+        out = fn(i, *args)
+        # "<Phase>.dispatch<i>": the fn() call itself. On a synchronous
+        # backend (CPU host-oracle detour) this IS shard i's own compute;
+        # on an async accelerator it is just the enqueue cost.
+        record_shard(f"{phase}.dispatch", i, time.perf_counter() - t0s[i])
+        outs.append(out)
+    ready = []
+    for i, o in enumerate(outs):
+        o = jax.block_until_ready(o)
+        record_shard(phase, i, time.perf_counter() - t0s[i])
+        ready.append(gather(o))
+    return ready
+
+
+__all__ = ["enabled", "n_shards", "device_count", "placement_on",
+           "shard_slices", "put_shard", "gather", "dispatch_shards",
+           "record_shard", "timers_snapshot", "SHARD_TIMERS", "ENV_FLAG"]

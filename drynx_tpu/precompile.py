@@ -41,6 +41,10 @@ def main(argv=None) -> int:
     ap.add_argument("--range-u", type=int, default=16)
     ap.add_argument("--range-l", type=int, default=5)
     ap.add_argument("--dlog-limit", type=int, default=10000)
+    ap.add_argument("--shards", type=int, default=None,
+                    help="proof-plane shard count; adds the per-shard "
+                         "program set (default: the plane's own policy — "
+                         "visible devices, DRYNX_PROOF_PLANE override)")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -52,9 +56,16 @@ def main(argv=None) -> int:
 
     from drynx_tpu import compilecache as cc
 
+    n_shards = args.shards
+    if n_shards is None:
+        from drynx_tpu.parallel import proof_plane as plane
+
+        n_shards = plane.n_shards()
+
     profile = cc.Profile(n_cns=args.n_cns, n_dps=args.n_dps,
                          n_values=args.values, u=args.range_u,
-                         l=args.range_l, dlog_limit=args.dlog_limit)
+                         l=args.range_l, dlog_limit=args.dlog_limit,
+                         n_shards=n_shards)
 
     if args.list:
         specs = cc.build_registry(profile)

@@ -633,6 +633,46 @@ def _commit_kernel(digits, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
     return D, m_tot, V_pts, a
 
 
+def _commit_kernel_sharded(digits, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
+                           gtA=None, gtA_pow=None, n_shards: int | None = None):
+    """Mesh-sharded commitment stage: the value axis V is the `dp` axis
+    (create_range_proof_lists_batched flattens n_dps*V onto it), so each DP
+    shard builds its slice's a_ij GT-table exponentiations locally through
+    the SAME per-shard `_commit_kernel` programs, and the commitments are
+    gathered once per batch before the Fiat-Shamir hash.
+
+    Bit-identical to one `_commit_kernel` call: proofs are per-value
+    independent, the bucketed programs pad inactive lanes away, and the
+    challenge hash runs over the gathered (concatenated) commitments —
+    tests/test_proof_mesh.py asserts byte-equal payloads."""
+    from ..parallel import proof_plane as plane
+
+    if n_shards is None:
+        n_shards = plane.n_shards()
+    V = int(digits.shape[0])
+    slices = plane.shard_slices(V, n_shards)
+    if len(slices) <= 1:
+        return _commit_kernel(digits, s, t, m, v, A_tab, ca_tbl, u, l,
+                              gtA=gtA, gtA_pow=gtA_pow)
+
+    def shard_commit(i, a, b):
+        # only the per-shard slices are committed to shard i's device; the
+        # shared tables (base/ca/A/gtA) stay uncommitted and follow the
+        # committed operands onto each shard's device
+        sd, ss, st, sm, sv = plane.put_shard(
+            (digits[a:b], s[a:b], t[a:b], m[a:b], v[:, a:b]), i)
+        return _commit_kernel(sd, ss, st, sm, sv, A_tab, ca_tbl, u, l,
+                              gtA=gtA, gtA_pow=gtA_pow)
+
+    parts = plane.dispatch_shards(
+        "CreateShard", shard_commit, [(a, b) for (a, b) in slices])
+    D = jnp.concatenate([p[0] for p in parts], axis=0)
+    m_tot = jnp.concatenate([p[1] for p in parts], axis=0)
+    V_pts = jnp.concatenate([p[2] for p in parts], axis=1)
+    a_out = jnp.concatenate([p[3] for p in parts], axis=1)
+    return D, m_tot, V_pts, a_out
+
+
 def _response_kernel(digits, c, rs, s, t, m_tot, v):
     """Response stage: given the bound challenge c, compute
     Zphi_j = s_j − c·φ_j, Zr = Σm − c·r, Zv_ij = t_j − c·v_ij."""
@@ -648,7 +688,8 @@ def _response_kernel(digits, c, rs, s, t, m_tot, v):
 
 def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
                         u: int, l: int, ca_pub_table,
-                        use_gt_table: bool = True) -> RangeProofBatch:
+                        use_gt_table: bool = True,
+                        shard: bool | None = None) -> RangeProofBatch:
     """Create proofs for V values at once.
 
     secrets: int64 (V,) plaintexts; rs: (V, 16) encryption blinding scalars;
@@ -659,6 +700,11 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     use_gt_table: compute a_ij via the cached e(B, A[k]) table (one GT
     exponentiation per digit) instead of a pairing per digit — u*ns one-time
     pairings amortized over every proof against these signatures.
+
+    shard: split the commitment stage over the proof-plane devices along
+    the value (`dp`) axis; None = shard iff the plane is enabled
+    (parallel/proof_plane.py — the default on a >= 2-device mesh).
+    Transcripts are bit-identical either way.
     """
     V = int(np.asarray(secrets).shape[0])
     ns = len(sigs)
@@ -681,7 +727,12 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     # commit -> Fiat-Shamir (binds D, V_pts, a) -> respond. The canonical
     # commitment bytes are computed ONCE here and cached on the batch: they
     # are both the hash input and the wire format (to_bytes reuses them).
-    D, m_tot, V_pts, a = _commit_kernel(
+    if shard is None:
+        from ..parallel import proof_plane as plane
+
+        shard = plane.enabled()
+    commit_fn = _commit_kernel_sharded if shard else _commit_kernel
+    D, m_tot, V_pts, a = commit_fn(
         digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA,
         gtA_pow=gtA_pow)
     wire = _range_wire_dict(cts, D, V_pts, a)
@@ -801,6 +852,23 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     The D-equation and Fiat-Shamir challenge are still checked per value
     (cheap G1 work). Returns one bool for the batch.
     """
+    pre_ok, r_int, gtb_pow_s = rlc_prelude(
+        proof, sigs_pub, ca_pub_table, rng=rng,
+        check_challenge=check_challenge)
+    if not pre_ok:
+        return False  # D equation / challenge binding failed — deterministic
+
+    total = rlc_total_single(proof, sigs_pub, r_int, gtb_pow_s)
+    return bool(np.asarray(F12.eq(total, jnp.asarray(F12.one(), dtype=jnp.uint32))))
+
+
+def rlc_total_single(proof: RangeProofBatch, sigs_pub, r_int, gtb_pow_s):
+    """The RLC check's (6, 2, 16) GT total on ONE device — equals F12.one()
+    iff the batch verifies under weights r_int. The pure single-device
+    fallback of the proof plane: parallel/proof_mesh.rlc_total_shards
+    computes the same total per-shard and MUST stay bit-identical to this
+    (tests/test_proof_mesh.py asserts array equality under a shared
+    weight draw)."""
     from ..crypto import batching as B
     from ..crypto import pallas_ops as po
 
@@ -808,12 +876,6 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     ys = jnp.asarray(np.stack([C.from_ref(p) for p in sigs_pub]), dtype=jnp.uint32)
     c, zphi = proof.challenge, proof.zphi
     base_tbl = eg.BASE_TABLE.table
-
-    pre_ok, r_int, gtb_pow_s = rlc_prelude(
-        proof, sigs_pub, ca_pub_table, rng=rng,
-        check_challenge=check_challenge)
-    if not pre_ok:
-        return False  # D equation / challenge binding failed — deterministic
     r = B.int_to_scalar(jnp.asarray(r_int, dtype=jnp.int64))               # (ns, V, l, 16)
 
     # r·(c·y_i − Zphi_j·B), then Miller only (final exp shared).
@@ -839,8 +901,7 @@ def verify_range_proofs_batch(proof: RangeProofBatch, sigs_pub, ca_pub_table,
     Pa = B.gt_reduce_prod(ar.reshape(-1, 6, 2, params.NUM_LIMBS))
 
     # gtB^(Σ r·Zv) comes from the shared prelude (one fixed-base power)
-    total = B.gt_mul(B.gt_mul(fe, Pa[None]), gtb_pow_s[None])[0]
-    return bool(np.asarray(F12.eq(total, jnp.asarray(F12.one(), dtype=jnp.uint32))))
+    return B.gt_mul(B.gt_mul(fe, Pa[None]), gtb_pow_s[None])[0]
 
 
 def rlc_prelude(proof: RangeProofBatch, sigs_pub, ca_pub_table,
@@ -1077,12 +1138,40 @@ def _list_structure_ok(lst: RangeProofList, ranges,
 
 
 def _safe_batch_verify(pb: RangeProofBatch, sigs_pub, ca_pub_table) -> bool:
-    """verify_range_proofs_batch with exception containment: a payload that
-    still manages to crash the kernels (despite _batch_shapes_ok) is a
-    FAILED verification for ITSELF — the exception must never propagate to
-    the flush-level catch-all, which would mark every sampled payload
-    BM_FALSE and poison honest DPs' audit entries."""
+    """The joint-range verification routing point, with exception
+    containment.
+
+    Routing: whenever the proof plane is enabled (>= 2 visible devices,
+    parallel/proof_plane.py), the DEFAULT path is the mesh-sharded verifier
+    — VN-role devices each verify a proof shard, combined by one GT
+    product. Its accept/reject decision is bit-identical to the
+    single-device verifier (same rlc_prelude, same per-element programs,
+    exact GT arithmetic), so the soundness semantics cannot differ. A
+    sharded-path FAILURE (an exception, not a False verdict) falls back to
+    the single-device verifier: a plane bug must not reject honest
+    payloads.
+
+    Containment: a payload that still manages to crash the kernels
+    (despite _batch_shapes_ok) is a FAILED verification for ITSELF — the
+    exception must never propagate to the flush-level catch-all, which
+    would mark every sampled payload BM_FALSE and poison honest DPs'
+    audit entries."""
     try:
+        from ..parallel import proof_plane as plane
+
+        if plane.enabled():
+            from ..parallel import proof_mesh as pm
+
+            try:
+                return pm.rlc_verify_sharded(pb, sigs_pub, ca_pub_table)
+            except Exception:
+                import traceback
+
+                from ..utils import log
+
+                log.warn("sharded verify raised — falling back to the "
+                         "single-device verifier: "
+                         + traceback.format_exc(limit=8))
         return verify_range_proofs_batch(pb, sigs_pub, ca_pub_table)
     except Exception:
         import traceback
@@ -1192,5 +1281,5 @@ __all__ = ["RangeSig", "init_range_sig", "sig_gt_table", "to_base",
            "verify_range_proofs", "verify_range_proofs_batch",
            "verify_range_proof_list", "verify_range_proof_lists_joint",
            "verify_range_proof_payloads_joint", "rlc_prelude",
-           "proof_challenge", "gt_base",
+           "rlc_total_single", "proof_challenge", "gt_base",
            "gt_base_table", "gt_pow_gtb", "sum_publics_bytes"]

@@ -454,12 +454,15 @@ class LocalCluster:
             return
         if self._aot_mode == "auto" and not po.available():
             return
+        from ..parallel import proof_plane as plane
+
         ranges = self._ranges_per_value(q)
         u0, l0 = ranges[0] if ranges else (16, 5)
         profile = cc.Profile(
             n_cns=len(self.cns), n_dps=len(self.dp_idents),
             n_values=max(len(ranges), 1), u=int(u0) or 16,
-            l=int(l0) or 5, dlog_limit=self.dlog.limit)
+            l=int(l0) or 5, dlog_limit=self.dlog.limit,
+            n_shards=plane.n_shards())
         with self._proof_device_lock:
             cc.trace_guard()
             before = cc.STATS.totals()

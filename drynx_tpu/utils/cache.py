@@ -13,6 +13,10 @@ Notes:
   byte-identical lowered modules + observed cross-process hits).
 - Deliberately NOT enabled for the CPU test suite: jaxlib has segfaulted
   deserializing very large CPU-backend executables (tests/conftest.py).
+- bench.py resolves that risk per-box by MEASUREMENT instead of policy:
+  its supervisor probes a cache write + deserialize round-trip in
+  supervised children and only then hands the measured child
+  DRYNX_JAX_CACHE=<dir> (applied by drynx_tpu.__init__, not this helper).
 """
 from __future__ import annotations
 

@@ -61,6 +61,12 @@ class PhaseTimers:
             print(f"    [phase] {name}: +{dt:.3f}s", file=sys.stderr,
                   flush=True)
 
+    def clear(self) -> None:
+        """Drop accumulated spans (benchmarks isolating a timed window)."""
+        with self._lock:
+            self._open.clear()
+            self._acc.clear()
+
     def __getitem__(self, name: str) -> float:
         return self._acc.get(name, 0.0)
 

@@ -1,0 +1,153 @@
+"""Sharded vs single-device joint-range verify throughput on the 8-way mesh.
+
+Produces MULTICHIP_r06.json: the proof-plane headline artifact for round 6
+— single-device RLC verify time vs the chunked 8-shard path
+(parallel/proof_mesh.rlc_total_shards), with per-shard spans from the
+plane's SHARD_TIMERS.
+
+HONESTY CONTRACT (read before quoting the numbers): this CI box is a
+single CPU core exposing 8 *fake* host-platform devices, so the measured
+sharded wall time CANNOT beat single-device — the 8 shard dispatches
+serialize on one core. What the artifact demonstrates on this box is
+(a) bit-identical sharded results and (b) balanced per-shard spans. The
+`projected_8dev_*` figures extrapolate the overlap a real 8-device mesh
+gives (JAX async dispatch runs shards concurrently; wall time -> max
+per-shard span + combine) and are labeled as projections with their basis
+— they are NOT measurements.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+         python scripts/bench_mesh_verify.py [--out MULTICHIP_r06.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="MULTICHIP_r06.json")
+    ap.add_argument("--values", type=int, default=9,
+                    help="V: values per batch (bench logreg: 9)")
+    ap.add_argument("--range-u", type=int, default=16)
+    ap.add_argument("--range-l", type=int, default=5)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.parallel import proof_mesh as pm
+    from drynx_tpu.parallel import proof_plane as plane
+    from drynx_tpu.proofs import range_proof as rp
+
+    u, l, v, ns = args.range_u, args.range_l, args.values, 3
+    rng = np.random.default_rng(91)
+    sigs = [rp.init_range_sig(u, rng) for _ in range(ns)]
+    pubs = [s.public for s in sigs]
+    _, ca_pub = eg.keygen(rng)
+    ca_tbl = eg.pub_table(ca_pub)
+    values = np.asarray(rng.integers(0, u ** l, size=v), dtype=np.int64)
+    cts, rs = eg.encrypt_ints(jax.random.PRNGKey(92), ca_tbl, values)
+    proof = rp.create_range_proofs(jax.random.PRNGKey(93), values, rs, cts,
+                                   sigs, u, l, ca_tbl.table, shard=False)
+
+    pre_ok, r_int, gtb_pow_s = rp.rlc_prelude(
+        proof, pubs, ca_tbl.table, rng=np.random.default_rng(94))
+    assert pre_ok, "honest proof failed the prelude"
+    n_items = ns * v * l
+
+    def best_of(fn):
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn())
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    single_s, total_single = best_of(
+        lambda: rp.rlc_total_single(proof, pubs, r_int, gtb_pow_s))
+
+    # one warmup dispatch outside the timed window, then clean timers
+    jax.block_until_ready(
+        pm.rlc_total_shards(proof, pubs, r_int, gtb_pow_s, n_shards=8))
+    plane.SHARD_TIMERS.clear()
+    sharded_s, total_shards = best_of(
+        lambda: pm.rlc_total_shards(proof, pubs, r_int, gtb_pow_s,
+                                    n_shards=8))
+    assert np.array_equal(np.asarray(total_single),
+                          np.asarray(total_shards)), \
+        "sharded GT total diverged from single-device"
+
+    # Per-shard timers accumulate across repeats; divide out. Two families
+    # from the plane: "VerifyShard.shard<i>" (dispatch-start ->
+    # outputs-ready) and "VerifyShard.dispatch.shard<i>" (the fn() call —
+    # on this synchronous CPU backend that IS shard i's own compute).
+    snap = {k: v / args.repeats for k, v in plane.timers_snapshot().items()}
+    spans = {k: v for k, v in snap.items()
+             if k.startswith("VerifyShard.shard")}
+    own = [snap[f"VerifyShard.dispatch.shard{i}"]
+           for i in range(len(spans))]
+    max_own = max(own) if own else sharded_s
+    ordered = [spans[f"VerifyShard.shard{i}"] for i in range(len(spans))]
+    combine_s = max(0.0, sharded_s - ordered[0]) if ordered else 0.0
+    projected_wall = max_own + combine_s
+    projected_speedup = single_s / projected_wall if projected_wall else 0.0
+
+    ncores = os.cpu_count() or 1
+    out = {
+        "round": 6,
+        "n_devices": plane.device_count(),
+        "n_shards": 8,
+        "host_platform_devices": jax.default_backend() == "cpu",
+        "physical_cpu_cores": ncores,
+        "batch": {"ns": ns, "V": v, "u": u, "l": l, "n_items": n_items},
+        "bit_identical_to_single_device": True,
+        "single_device_verify_s": round(single_s, 4),
+        "sharded_verify_measured_s": round(sharded_s, 4),
+        "measured_speedup": round(single_s / sharded_s, 3) if sharded_s
+                            else 0.0,
+        "per_shard_span_s": {k: round(s, 4) for k, s in sorted(spans.items())},
+        "per_shard_own_compute_s": [round(s, 4) for s in own],
+        "shard_balance": round(min(own) / max_own, 3) if own else 1.0,
+        "combine_overhead_s": round(combine_s, 4),
+        "projected_8dev_wall_s": round(projected_wall, 4),
+        "projected_8dev_speedup_vs_single": round(projected_speedup, 2),
+        "projected_8dev_verify_throughput_items_per_s":
+            round(n_items / projected_wall, 1) if projected_wall else 0.0,
+        "single_device_verify_throughput_items_per_s":
+            round(n_items / single_s, 1) if single_s else 0.0,
+        "projection_basis": (
+            "8 fake host-platform devices share {} physical core(s), so "
+            "shard dispatches SERIALIZE here and measured_speedup ~1x is "
+            "expected. per_shard_own_compute_s is each shard's measured "
+            "synchronous dispatch span (its own serial compute); on a real "
+            "8-device mesh JAX async dispatch overlaps the shards, so "
+            "wall time = max own-compute + GT combine. projected_* "
+            "figures apply that overlap model to the measured per-shard "
+            "compute; they are projections, not measurements."
+            .format(ncores)),
+    }
+    path = args.out
+    if not os.path.isabs(path):
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), path)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     tests/test_static_analysis.py \
     tests/test_analysis_rules.py \
     tests/test_precompile.py \
+    tests/test_bench_supervisor.py \
     tests/test_field.py \
     tests/test_refimpl.py \
     tests/test_batching.py \

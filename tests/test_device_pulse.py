@@ -4,29 +4,55 @@ The CPU suite deliberately routes the pairing family to the host oracle /
 native C++ backend (crypto/host_oracle.py) because interpret-mode compiles
 of the big Mosaic kernels cost hours on this box — which left the device
 dispatch path with zero default-tier coverage (round-4 verdict weak #5).
-This file is the opt-OUT counterweight: every default suite run executes
+This file is the opt-OUT counterweight, now a ROTATION over all 14
+hardware-validated kernels (TESTS_TPU.json / scripts/pallas_parity.py):
 
-  * one pairing-family Mosaic kernel (`f12_slotmul_flat` frob1 — the
-    smallest graph in the family; batch 1, interpret mode) against the
-    pure-Python oracle, and
-  * one G1 kernel THROUGH the full `batching.host_dispatch` -> bucketed
-    kernel route with the host oracle force-disabled (the exact branch a
-    real TPU process takes), compared host-side against `refimpl`.
+  * every run executes ONE rotation entry, picked by calendar day
+    (``date.today().toordinal() % 14``) or pinned via
+    ``DRYNX_PULSE_KERNEL=<index>`` — over two weeks of CI runs every
+    hardware-validated kernel gets default-tier coverage;
+  * "execute" — cheap kernels (measured interpret-mode compile at
+    batch 1: slotmul 31.5 s, csqr 73.6 s) run in interpret mode and
+    compare against the pure-Python oracle;
+  * "trace" — heavy kernels (f12_mul alone is 286 s of interpret-mode
+    XLA compile; miller is hours) get ``jax.make_jaxpr`` pulses: the
+    whole kernel-body Python runs abstractly — shape/dtype/index logic
+    and API drift are exercised without the XLA compile or the
+    eager-interpret execution bill. Measured trace costs on this box:
+    fixed_base 4 s, ladder16/64 ~40 s, f12_mul+inv 43 s, miller 84 s,
+    wpow@63 116 s, mulreduce8 121 s, g2_ladder 190 s (worst day);
+  * "glue" — entries whose DEVICE kernels all have their own rotation
+    day (order_gate = slotmul/wpow/mul; gt_pow_fixed_multi = gather +
+    mulreduce8; final_exp = wpow/inv/mul/csqr/slotmul) trace or run the
+    composition with those children stubbed to shape-identities: the
+    unique wiring (gate logic, window-digit extraction, the Olivos
+    chain) is exercised for seconds instead of the 4-20 min a full
+    abstract trace of the composition costs — each stubbed child's real
+    body is covered by its own day;
+  * numeric parity for every trace/glue entry stays covered on-chip
+    (scripts/pallas_parity.py, TESTS_TPU.json) and behind
+    DRYNX_PALLAS_INTERPRET_TESTS=1 (test_pallas_pairing);
+  * one G1 kernel always runs THROUGH the full `batching.host_dispatch`
+    -> bucketed kernel route with the host oracle force-disabled (the
+    exact branch a real TPU process takes), compared against `refimpl`.
 
-Budget: ~2.5 min on the 1-core CI box (measured 138 s + 8 s); the heavy
-kernels stay behind DRYNX_PALLAS_INTERPRET_TESTS=1 (test_pallas_pairing)
-and on-chip validation (scripts/pallas_parity.py, TESTS_TPU.json).
-Reference analogue: kyber's arithmetic is exercised by every Go test; ours
-must not go a round with the compiled path unexecuted.
+Reference analogue: kyber's arithmetic is exercised by every Go test;
+ours must not go a round with the compiled path unexecuted.
 """
-import numpy as np
+import datetime
+import os
+
+import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from drynx_tpu.crypto import batching as B
 from drynx_tpu.crypto import curve as C
+from drynx_tpu.crypto import elgamal as eg
 from drynx_tpu.crypto import field as F
 from drynx_tpu.crypto import fp12 as F12
+from drynx_tpu.crypto import g2 as G2
 from drynx_tpu.crypto import host_oracle as ho
 from drynx_tpu.crypto import pallas_ops as po
 from drynx_tpu.crypto import pallas_pairing as pp
@@ -48,12 +74,231 @@ def _rfp() -> int:
     return int.from_bytes(RNG.bytes(40), "little") % params.P
 
 
-def test_pairing_family_kernel_pulse():
-    """f12_slotmul_flat (frob1) vs the oracle — device pairing code."""
-    a = tuple((_rfp(), _rfp()) for _ in range(6))
+def _rf12():
+    return tuple((_rfp(), _rfp()) for _ in range(6))
+
+
+def _d_gt():
+    return jnp.asarray(F12.from_ref(refimpl.pair(refimpl.G1, refimpl.G2)))
+
+
+def _trace(fn, *args):
+    """Trace pulse: build the jaxpr (runs the kernel-body Python
+    abstractly, including the pallas grid/index/mont-mul code) and return
+    its output avals. No XLA compile, no execution."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    assert jaxpr.eqns, "kernel traced to an empty jaxpr"
+    return jaxpr.out_avals
+
+
+def _assert_limbs(avals, lead_shape):
+    (a,) = avals
+    assert a.dtype == jnp.uint32
+    assert tuple(a.shape[:len(lead_shape)]) == tuple(lead_shape)
+    assert a.shape[-1] == 16
+
+
+class _patched:
+    """Temporarily rebind module attributes (glue pulses stub the child
+    flat kernels — each child's real body has its own rotation day)."""
+
+    def __init__(self, mod, **attrs):
+        self.mod, self.attrs, self.saved = mod, attrs, {}
+
+    def __enter__(self):
+        for k, v in self.attrs.items():
+            self.saved[k] = getattr(self.mod, k)
+            setattr(self.mod, k, v)
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            setattr(self.mod, k, v)
+
+
+def _fe_children_stubbed():
+    """final_exp_flat's children as shape-identities."""
+    return _patched(
+        pp,
+        f12_mul_flat=lambda a, b: a,
+        f12_inv_flat=lambda a: a,
+        f12_csqr_flat=lambda a: a,
+        f12_slotmul_flat=lambda a, which: a,
+        f12_wpow_flat=lambda f, k, **kw: f,
+    )
+
+
+# --- execute pulses (cheap interpret-mode compiles, measured) ------------
+
+def pulse_slotmul():
+    a = _rf12()
     da = jnp.asarray(F12.from_ref(a))[None]
     got = pp.f12_slotmul_flat(da, "frob1")
     assert F12.to_ref(np.asarray(got)[0]) == ho._fp12_frob(a, 1)
+
+
+def pulse_csqr():
+    gt = refimpl.pair(refimpl.G1, refimpl.G2)
+    got = pp.f12_csqr_flat(jnp.asarray(F12.from_ref(gt))[None])
+    assert F12.to_ref(np.asarray(got)[0]) == refimpl.fp12_sq(gt)
+
+
+# --- trace pulses (heavy kernels: jaxpr build only) ----------------------
+
+def pulse_wpow_cyc():
+    k = jnp.asarray(F.from_int(0x2FFFFFFFFFFFFFFF))[None]
+    _assert_limbs(_trace(
+        lambda d, kk: pp.f12_wpow_flat(d, kk, n_bits=63, cyc=True),
+        _d_gt()[None], k), (1, 6, 2))
+
+
+def pulse_gt_pow_fixed_multi():
+    # glue: window_digits extraction + the per-base table gather, with
+    # mulreduce8 stubbed (own rotation day) and a synthetic ones-table
+    # (the real sig-table build is minutes of host bignum math)
+    T = jnp.ones((2, 64, 16, 6, 2, 16), dtype=jnp.uint32)
+    base_idx = jnp.asarray([0], dtype=jnp.int32)
+    k = jnp.asarray(F.from_int([12345]))
+    with _patched(pp, f12_mulreduce8_flat=lambda gg: gg[:, 0]):
+        avals = _trace(lambda bi, kk: pp.gt_pow_fixed_multi(T, bi, kk),
+                       base_idx, k)
+    _assert_limbs(avals, (1, 6, 2))
+
+
+def pulse_ladder16():
+    pd = jnp.asarray(C.from_ref_batch([refimpl.g1_mul(refimpl.G1, 3)]))
+    kd = jnp.asarray(F.from_int([5]))
+    _assert_limbs(_trace(
+        lambda p, k: po.scalar_mul_flat(p, k, n_windows=16), pd, kd),
+        (1, 3))
+
+
+def pulse_order_gate():
+    # glue: both gates' wiring (reshape, the t-1 = p - n broadcast, the
+    # np.all reduction) through the DEVICE branch with the batched GT
+    # ops stubbed — each underlying kernel (slotmul frobenius, wpow@128,
+    # f12_mul) has its own rotation day. A full abstract trace of the
+    # bucketed composition exceeds 300 s on this box.
+    def eq_stub(a, b):
+        return jnp.ones((a.shape[0],), dtype=jnp.bool_)
+
+    with _patched(ho, ENABLED=False), _patched(
+            B,
+            gt_frob1=lambda a: a,
+            gt_frob2=lambda a: a,
+            gt_mul=lambda a, b: a,
+            gt_pow128=lambda f, k: f,
+            gt_eq=eq_stub):
+        a = _d_gt()[None]
+        assert B.gt_membership_ok(a) is True
+        assert B.gt_order_ok(a) is True
+
+
+def pulse_f12_mul_inv():
+    a = jnp.asarray(F12.from_ref(_rf12()))[None]
+    _assert_limbs(_trace(pp.f12_mul_flat, a, a), (1, 6, 2))
+    _assert_limbs(_trace(pp.f12_inv_flat, a), (1, 6, 2))
+
+
+def pulse_mulreduce8():
+    d = jnp.asarray(np.stack([F12.from_ref(_rf12())
+                              for _ in range(8)]))[None]
+    _assert_limbs(_trace(pp.f12_mulreduce8_flat, d), (1, 6, 2))
+
+
+def pulse_ladder64():
+    pd = jnp.asarray(C.from_ref_batch([refimpl.g1_mul(refimpl.G1, 11)]))
+    kd = jnp.asarray(F.from_int([9]))
+    _assert_limbs(_trace(po.scalar_mul_flat, pd, kd), (1, 3))
+
+
+def pulse_fixed_base():
+    kd = jnp.asarray(F.from_int([3]))
+    _assert_limbs(_trace(
+        lambda k: po.fixed_base_mul_flat(eg.BASE_TABLE.table, k), kd),
+        (1, 3))
+
+
+def pulse_g2_ladder():
+    q = jnp.asarray(np.stack([G2.from_ref(refimpl.G2)]))
+    kd = jnp.asarray(F.from_int([7]))
+    _assert_limbs(_trace(pp.g2_scalar_mul_flat, q, kd), (1,))
+
+
+def pulse_final_exp():
+    # glue: the easy part + DSD hard part + Olivos chain structure with
+    # the child kernels stubbed (wpow/inv/mul/csqr/slotmul each have
+    # their own day); a full abstract trace is ~4 min (3 wpow@63 chains)
+    with _fe_children_stubbed():
+        jaxpr = jax.make_jaxpr(pp.final_exp_flat)(_d_gt()[None])
+    _assert_limbs(jaxpr.out_avals, (1, 6, 2))
+
+
+def _pair_args():
+    p = refimpl.g1_mul(refimpl.G1, 9)
+    return (jnp.asarray(F.from_int([p[0] * params.R % params.P])),
+            jnp.asarray(F.from_int([p[1] * params.R % params.P])),
+            jnp.asarray(G2.from_ref(refimpl.G2)[0][None]),
+            jnp.asarray(G2.from_ref(refimpl.G2)[1][None]))
+
+
+def pulse_pair():
+    # the REAL Miller kernel body (84 s abstract trace) composed through
+    # pair_flat, with only final_exp's children stubbed (own days)
+    with _fe_children_stubbed():
+        avals = _trace(pp.pair_flat, *_pair_args())
+    _assert_limbs(avals, (1, 6, 2))
+
+
+def pulse_miller_then_fe():
+    # parity's explicit two-step composition: real Miller trace, then
+    # final_exp applied OUTSIDE (fe children stubbed — own days)
+    with _fe_children_stubbed():
+        avals = _trace(
+            lambda a, b, c, d: pp.final_exp_flat(
+                pp.miller_flat(a, b, c, d)), *_pair_args())
+    _assert_limbs(avals, (1, 6, 2))
+
+
+# Order mirrors scripts/pallas_parity.py / TESTS_TPU.json: the 14
+# hardware-validated kernel checks. mode "execute" = interpret-mode run +
+# oracle comparison; "trace" = full jaxpr build + aval check; "glue" =
+# composition with child kernels stubbed (see module docstring).
+ROTATION = [
+    ("csqr", "execute", pulse_csqr),
+    ("wpow_cyc", "trace", pulse_wpow_cyc),
+    ("gt_pow_fixed_multi", "glue", pulse_gt_pow_fixed_multi),
+    ("ladder16", "trace", pulse_ladder16),
+    ("slotmul", "execute", pulse_slotmul),
+    ("order_gate", "glue", pulse_order_gate),
+    ("f12_mul_inv", "trace", pulse_f12_mul_inv),
+    ("mulreduce8", "trace", pulse_mulreduce8),
+    ("ladder64", "trace", pulse_ladder64),
+    ("fixed_base", "trace", pulse_fixed_base),
+    ("g2_ladder", "trace", pulse_g2_ladder),
+    ("final_exp", "glue", pulse_final_exp),
+    ("pair", "glue", pulse_pair),
+    ("miller_then_fe", "glue", pulse_miller_then_fe),
+]
+
+
+def rotation_index(env=os.environ) -> int:
+    pinned = env.get("DRYNX_PULSE_KERNEL", "")
+    if pinned:
+        return int(pinned) % len(ROTATION)
+    return datetime.date.today().toordinal() % len(ROTATION)
+
+
+def test_rotation_covers_all_validated_kernels():
+    assert len(ROTATION) == 14
+    assert len({n for n, _, _ in ROTATION}) == 14
+    assert {m for _, m, _ in ROTATION} == {"execute", "trace", "glue"}
+
+
+def test_rotating_kernel_pulse():
+    idx = rotation_index()
+    name, mode, fn = ROTATION[idx]
+    print(f"device pulse [{idx}/{len(ROTATION)}]: {name} ({mode})")
+    fn()
 
 
 def test_g1_kernel_dispatch_pulse(monkeypatch):

@@ -62,6 +62,45 @@ def test_registry_scales_with_profile():
             assert bucket <= 2048
 
 
+def test_registry_sharded_program_set():
+    """Profile.n_shards > 1 must add the proof-plane per-shard programs
+    (the smaller buckets each mesh device dispatches) on BOTH phases —
+    creation and verification — and must only ever ADD programs: the
+    single-shard registry is a strict subset, so sharding can never
+    silently drop AOT coverage of the fallback path."""
+    base = cc.BENCH
+    sharded = cc.build_registry(
+        cc.Profile(n_cns=base.n_cns, n_dps=base.n_dps,
+                   n_values=base.n_values, u=base.u, l=base.l,
+                   dlog_limit=base.dlog_limit, n_shards=8))
+    flat = cc.build_registry(base)
+    flat_names = {s.name for s in flat}
+    sharded_names = {s.name for s in sharded}
+    assert flat_names <= sharded_names
+    extra = [s for s in sharded if s.name not in flat_names]
+    assert extra, "n_shards=8 must add per-shard programs"
+    phases = {s.phase for s in extra}
+    assert phases <= {"RangeProofVerifyShard", "RangeProofCreateShard"}
+    assert "RangeProofVerifyShard" in phases
+    assert "RangeProofCreateShard" in phases
+    # the verify shard's pairing programs at the per-shard bucket
+    ops = {s.op for s in extra}
+    assert {"miller", "gt_pow64"} <= ops
+    # per-shard buckets are smaller than the full flat batch
+    for s in extra:
+        if s.kind == "bucketed":
+            assert int(s.name.rsplit("@", 1)[1]) <= 2048
+
+
+def test_registry_n_shards_one_is_identity():
+    base = cc.BENCH
+    one = cc.build_registry(
+        cc.Profile(n_cns=base.n_cns, n_dps=base.n_dps,
+                   n_values=base.n_values, u=base.u, l=base.l,
+                   dlog_limit=base.dlog_limit, n_shards=1))
+    assert {s.name for s in one} == {s.name for s in cc.build_registry(base)}
+
+
 def test_driver_lower_smoke_cheap_program():
     """spec.lower() on the cheapest scalar-field program returns an AOT
     Lowered (compile()-able); the driver records it as 'lowered'."""
@@ -107,3 +146,16 @@ def test_cli_list_exits_zero(capsys):
     out = capsys.readouterr().out
     assert "bucketed:fn_add" in out and "fused:dec" in out
     assert "programs" in out
+
+
+def test_cli_list_shards_includes_shard_programs(capsys):
+    from drynx_tpu import precompile as cli
+
+    assert cli.main(["--list", "--shards", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "RangeProofVerifyShard" in out
+    assert "RangeProofCreateShard" in out
+    # and forcing a single shard removes them again
+    assert cli.main(["--list", "--shards", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "RangeProofVerifyShard" not in out
