@@ -12,13 +12,13 @@ from .core import (REPO_ROOT, RULES, BaselineEntry, Finding, ModuleInfo,
                    Rule, analyze_paths, analyze_source, apply_baseline,
                    load_baseline, module_info_for)
 from .project import ProjectInfo, ProjectRule, analyze_project
-from .dataflow import Dataflow, dataflow_for
+from .dataflow import Dataflow, Secret, dataflow_for
 from .sarif import to_sarif
 from . import rules as _rules  # noqa: F401  (populate the registry)
 from .cli import DEFAULT_BASELINE, main
 
 __all__ = ["REPO_ROOT", "RULES", "BaselineEntry", "Finding", "ModuleInfo",
-           "Rule", "ProjectInfo", "ProjectRule", "Dataflow",
+           "Rule", "ProjectInfo", "ProjectRule", "Dataflow", "Secret",
            "analyze_paths", "analyze_project", "analyze_source",
            "apply_baseline", "dataflow_for", "load_baseline",
            "module_info_for", "to_sarif", "DEFAULT_BASELINE", "main"]

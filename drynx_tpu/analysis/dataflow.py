@@ -68,6 +68,13 @@ _DUMP_LEAVES = {"dump", "dumps"}
 _NONCE_LEAVES = {"randbelow"}
 _CLEARTEXT_LEAVES = {"load_csv", "loadtxt", "genfromtxt"}
 
+# Container mutation methods: ``xs.append(secret)`` taints the binding of
+# ``xs`` itself (container-sensitive secrecy — the dual of the existing
+# ``d[k] = v`` subscript-assign rule), so a later ``log.info(xs)`` still
+# sees the taint even though no assignment statement touched ``xs``.
+_MUTATOR_LEAVES = {"append", "appendleft", "add", "insert", "extend",
+                   "update", "setdefault"}
+
 # Introspection builtins whose result is public whatever goes in (a
 # length/type/id does not reveal the value), and digest methods — hashing
 # IS the redaction the secret-flow findings ask for, so it declassifies.
@@ -89,6 +96,30 @@ _PRESERVING_FUNCS = {"transpose", "reshape", "concatenate", "stack",
 _PRESERVING_METHODS = {"reshape", "transpose", "ravel", "squeeze",
                        "swapaxes", "copy", "flatten", "block_until_ready"}
 _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+class Secret:
+    """Annotation-only marker: ``sk: Secret[int]`` (or the string form
+    ``"Secret[int]"``) seeds the secrecy lattice at the annotation site —
+    the way to declare a secret that the definition-site seeds (keygen /
+    randbelow / cleartext loads) cannot see, e.g. a key passed in from a
+    caller outside the analyzed tree. Erased at runtime: subscripting
+    returns the class itself, so the annotation costs nothing."""
+
+    def __class_getitem__(cls, _item):
+        return cls
+
+
+def _is_secret_ann(ann: Optional[ast.AST]) -> bool:
+    """True for ``Secret[...]`` / ``x.Secret[...]`` / bare ``Secret`` and
+    their string-literal forms."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        head = ann.value.split("[", 1)[0]
+        return head.split(".")[-1].strip() == "Secret"
+    node = ann.value if isinstance(ann, ast.Subscript) else ann
+    return (_dotted(node) or "").split(".")[-1] == "Secret"
 
 
 def _is_uint32_dtype(expr: ast.AST) -> bool:
@@ -427,8 +458,14 @@ class _Interp:
                 key = self._self_class()
                 self.env[arg.arg] = ObjVal(key, {}) if key else TOP
                 continue
-            self.env[arg.arg] = AV(dtype_src=idx,
-                                   secret_src=frozenset((idx,)))
+            av = AV(dtype_src=idx, secret_src=frozenset((idx,)))
+            if _is_secret_ann(arg.annotation):
+                hop = chain_hop(self.rel, arg.lineno,
+                                f"Secret[...] annotated parameter "
+                                f"'{arg.arg}'")
+                av = dataclasses.replace(av, secrecy=SEC_SECRET,
+                                         secret_chain=(hop,))
+            self.env[arg.arg] = av
             self.params.append(arg.arg)
             idx += 1
         if a.vararg:
@@ -464,9 +501,19 @@ class _Interp:
             for t in stmt.targets:
                 self.assign(t, v)
         elif isinstance(stmt, ast.AnnAssign):
+            secret_ann = _is_secret_ann(stmt.annotation)
             if stmt.value is not None:
                 v = self._declassify(self.eval(stmt.value), stmt.lineno)
-                self.assign(stmt.target, v)
+            elif secret_ann:
+                # declaration-only form (``sk: Secret[int]``): bind the
+                # seed so later reads of the name carry it
+                v = TOP
+            else:
+                return
+            if secret_ann:
+                v = self._mark_secret(v, stmt.lineno,
+                                      "Secret[...] annotated binding")
+            self.assign(stmt.target, v)
         elif isinstance(stmt, ast.AugAssign):
             cur = TOP
             if isinstance(stmt.target, ast.Name):
@@ -622,6 +669,25 @@ class _Interp:
         if isinstance(v, ObjVal):
             return TOP
         return v
+
+    def _mark_secret(self, v: ValT, lineno: int, what: str) -> ValT:
+        """Structurally force ``v`` secret (annotation seeds). Leaves that
+        are already secret keep their original, more precise chain."""
+        hop = chain_hop(self.rel, lineno, what)
+
+        def mark(x: ValT) -> ValT:
+            if isinstance(x, TupleVal):
+                return TupleVal(tuple(mark(e) for e in x.elts))
+            if isinstance(x, ObjVal):
+                return ObjVal(x.cls, {k: mark(e)
+                                      for k, e in x.fields.items()})
+            if x.secrecy == SEC_SECRET:
+                return x
+            return dataclasses.replace(
+                x, secrecy=SEC_SECRET,
+                secret_chain=_cap(x.secret_chain + (hop,)))
+
+        return mark(v)
 
     def _declassify(self, v: ValT, lineno: int) -> ValT:
         if not (1 <= lineno <= len(self.info.lines)):
@@ -841,6 +907,24 @@ class _Interp:
 
         self._check_secret_sinks(call, d, leaf, recv, argvals, kwvals)
         self._check_dtype_sinks(call, leaf, recv, argvals)
+
+        if (leaf in _MUTATOR_LEAVES and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in self.env):
+            # container-sensitive secrecy: xs.append(secret) re-binds xs
+            # with the argument's taint joined in (mirrors the
+            # subscript-assign rule in ``assign``)
+            folded = self._fold([collapse(v)
+                                 for v in argvals + list(kwvals.values())])
+            if folded.secrecy == SEC_SECRET or folded.secret_src:
+                name = call.func.value.id
+                hop = chain_hop(self.rel, call.lineno,
+                                f".{leaf}() into container '{name}'")
+                self.env[name] = join_av(
+                    collapse(self.env[name]),
+                    AV(secrecy=folded.secrecy,
+                       secret_src=folded.secret_src,
+                       secret_chain=_cap(folded.secret_chain + (hop,))))
 
         seeded = self._seed(call, d, leaf)
         if seeded is not None:

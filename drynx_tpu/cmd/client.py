@@ -120,6 +120,38 @@ def cmd_survey_run(args) -> int:
             n_vns=roles.count("vn") if sv.get("proofs") else 0,
             dlog_limit=int(sv.get("dlog_limit", 10000)))
         client = DrynxClient(cluster)
+        if args.serve > 1:
+            # standing-server mode: N copies of the survey through the
+            # scheduler (SERVER.md) — equal shapes batch at the VNs
+            from ..server import SurveyServer
+
+            server = SurveyServer(cluster, max_batch=args.serve)
+            admissions = {}
+            for i in range(args.serve):
+                sq = client.generate_survey_query(
+                    op, query_min=qmin, query_max=qmax,
+                    proofs=1 if sv.get("proofs") else 0,
+                    obfuscation=bool(sv.get("obfuscation", False)),
+                    survey_id=f"cli{i}")
+                admissions[sq.survey_id] = server.submit(sq)
+            results = server.drain()
+            out = {"operation": op, "surveys": {}}
+            ok = True
+            for sid, a in admissions.items():
+                res = results.get(sid)
+                if isinstance(res, Exception):
+                    out["surveys"][sid] = {"lane": a.lane,
+                                           "error": str(res)}
+                    ok = False
+                    continue
+                entry = {"lane": a.lane, "result": _jsonable(res.result)}
+                if res.block is not None:
+                    entry["bitmap_ok"] = all(
+                        v == 1 for v in res.block.data.bitmap.values())
+                    ok = ok and entry["bitmap_ok"]
+                out["surveys"][sid] = entry
+            print(json.dumps(out))
+            return 0 if ok else 1
         sq = client.generate_survey_query(
             op, query_min=qmin, query_max=qmax,
             proofs=1 if sv.get("proofs") else 0,
@@ -230,6 +262,10 @@ def main(argv=None) -> int:
     s_op.set_defaults(fn=cmd_survey_set_operation)
     s_run = srv.add_parser("run")
     s_run.add_argument("--local", action="store_true")
+    s_run.add_argument("--serve", type=int, default=1, metavar="N",
+                       help="local only: submit N copies of the survey "
+                            "through the standing SurveyServer scheduler "
+                            "(batched verification; see SERVER.md)")
     s_run.set_defaults(fn=cmd_survey_run)
 
     args = p.parse_args(argv)

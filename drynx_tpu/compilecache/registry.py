@@ -55,6 +55,9 @@ class Profile:
     dlog_limit: int = 10000
     n_shards: int = 1       # proof-plane shards (parallel/proof_plane.py);
                             # >1 adds the per-shard program set
+    n_queue: int = 1        # cross-survey batch width (drynx_tpu/server):
+                            # >1 adds the cross-survey verify program set
+                            # at n_queue-concatenated batch sizes
 
 
 BENCH = Profile()
@@ -77,6 +80,9 @@ class ProgramSpec:
     lower: Callable[[], object]
     dispatched: Callable[[], bool]
     call: Callable[[], object] | None = None
+    family: str = ""        # gate family: "device" | "g1" | "pairing" |
+                            # "pallas" (the server's compile lane executes
+                            # just the cheap device family on CPU)
 
 
 # ---------------------------------------------------------------------------
@@ -219,7 +225,15 @@ _B_SCHEMAS: list = [
      [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
      "RangeProofWire", "device"),
     ("to_mont_p", lambda p, b: (_scalar(b),),
-     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
+     # encode batch, plus the per-payload DECODE shapes (_g1/_g2/_gt
+     # _from_bytes): commit x|y at 2V, d at V, each G2 component at
+     # ns*V*l, the GT response at 12*ns*V*l — the verify worker of
+     # drynx_tpu/server deserializes payloads off the main thread, so
+     # these buckets must be registry-warmable
+     [lambda p: p.n_cns * p.n_dps * p.n_values * p.l,
+      lambda p: 2 * p.n_values, lambda p: p.n_values,
+      lambda p: p.n_cns * p.n_values * p.l,
+      lambda p: 12 * p.n_cns * p.n_values * p.l],
      "RangeProofWire", "device"),
     # --- G1/G2 family (host-native detour on CPU when the lib built) ---
     ("g1_add", lambda p, b: (_g1(b), _g1(b)),
@@ -346,6 +360,74 @@ def _shard_schemas(p: Profile) -> list:
     ]
 
 
+def _queue_schemas(p: Profile) -> list:
+    """The cross-survey verify program set of the standing survey server
+    (drynx_tpu/server): `n_queue` equal-shape surveys' joint digit batches
+    concatenated along the value axis verify in ONE RLC dispatch
+    (proofs/range_proof.verify_cross_survey_payloads_joint ->
+    parallel/proof_mesh.rlc_total_shards at phase CrossSurveyVerifyShard).
+    Same bucketed ops as the verify schemas, at the n_queue-scaled batch
+    sizes. Empty when n_queue <= 1, so single-survey registries are a
+    subset of queued ones (tests/test_precompile.py enforces both
+    directions, mirroring the n_shards contract)."""
+    if p.n_queue <= 1:
+        return []
+
+    def cdiv(a, k):
+        return -(-a // k)
+
+    # value axis of the cross-survey concatenation, and its digit batch
+    qv = lambda p: p.n_queue * p.n_dps * p.n_values
+    qd = lambda p: p.n_cns * qv(p) * p.l
+    # per-shard slice of the concatenated digit batch (chunked dispatch)
+    qs = lambda p: cdiv(qd(p), max(1, p.n_shards))
+    return [
+        # --- rlc_prelude over the concatenation (D eq, challenge, weights)
+        ("fn_add", lambda p, b: (_scalar(b), _scalar(b)),
+         [qv, lambda p: qv(p) * p.l, qd], "CrossSurveyVerify", "device"),
+        ("fn_sub", lambda p, b: (_scalar(b), _scalar(b)),
+         [qd], "CrossSurveyVerify", "device"),
+        ("fn_neg", lambda p, b: (_scalar(b),),
+         [lambda p: qv(p) * p.l, qd], "CrossSurveyVerify", "device"),
+        ("fn_mul_plain", lambda p, b: (_scalar(b), _scalar(b)),
+         [qd], "CrossSurveyVerify", "device"),
+        # rlc weights + challenge recompute over the concatenation (the
+        # only family the verify worker dispatches as jits on CPU — the
+        # g1/pairing families host-detour there, so warming these is what
+        # keeps the pipeline's verify thread trace-free)
+        ("int_to_scalar", lambda p, b: (_i64(b),),
+         [qv, lambda p: qv(p) * p.l, qd], "CrossSurveyVerify", "device"),
+        ("to_mont_p", lambda p, b: (_scalar(b),),
+         [qv, lambda p: qv(p) * p.l, qd], "CrossSurveyVerify", "device"),
+        ("from_mont_p", lambda p, b: (_scalar(b),),
+         [qv, lambda p: qv(p) * p.l, qd], "CrossSurveyVerify", "device"),
+        # --- _g1_prep + the single-device fallback verifier ---
+        ("g1_neg", lambda p, b: (_g1(b),),
+         [qv], "CrossSurveyVerify", "g1"),
+        ("g1_scalar_mul", lambda p, b: (_g1(b), _scalar(b)),
+         [qv, lambda p: p.n_cns * qv(p)], "CrossSurveyVerify", "g1"),
+        ("g1_scalar_mul64", lambda p, b: (_g1(b), _scalar(b)),
+         [qv, qd], "CrossSurveyVerify", "g1"),
+        ("g1_add", lambda p, b: (_g1(b), _g1(b)),
+         [qd], "CrossSurveyVerify", "g1"),
+        ("g1_normalize", lambda p, b: (_g1(b),),
+         [qd], "CrossSurveyVerify", "g1"),
+        ("g2_normalize", lambda p, b: (_g2(b),),
+         [qd], "CrossSurveyVerify", "g1"),
+        ("fixed_base_mul", lambda p, b: (_fb_table(), _scalar(b)),
+         [lambda p: qv(p) * p.l], "CrossSurveyVerify", "g1"),
+        ("pair", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+         [qd], "CrossSurveyVerify", "pairing"),
+        ("gt_pow64", lambda p, b: (_gt(b), _scalar(b)),
+         [qv], "CrossSurveyVerify", "pairing"),
+        # --- rlc_total_shards per-shard body over the concatenation ---
+        ("miller", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+         [qs], "CrossSurveyVerifyShard", "pairing"),
+        ("gt_pow64", lambda p, b: (_gt(b), _scalar(b)),
+         [qs], "CrossSurveyVerifyShard", "pairing"),
+    ]
+
+
 # Raw Pallas flat entry points the bucketed family dispatches internally on
 # TPU. Registered explicitly so their Mosaic compiles land in the
 # persistent cache even for call sites outside bucketed wrappers
@@ -465,7 +547,8 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
 
     specs: dict[str, ProgramSpec] = {}
     for op, args_fn, batches, phase, gate in (
-            _B_SCHEMAS + _shard_schemas(profile)):
+            _B_SCHEMAS + _shard_schemas(profile)
+            + _queue_schemas(profile)):
         w = B.BUCKETED_OPS.get(op)
         for bexpr in batches:
             batch = int(bexpr(profile))
@@ -490,7 +573,7 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
                 return BUCKETED_OPS[op](*args_fn(profile, bucket))
 
             specs[name] = ProgramSpec(name, op, "bucketed", phase, lower,
-                                      _GATES[gate], call)
+                                      _GATES[gate], call, family=gate)
     for s in _pallas_specs(profile) + _fused_specs(profile):
         specs[s.name] = s
     return list(specs.values())
@@ -502,8 +585,15 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
 
 def precompile(profile: Profile = BENCH, mode: str = "compile",
                stats: CompileStats | None = None,
-               log: Callable[[str], None] | None = None) -> CompileStats:
+               log: Callable[[str], None] | None = None,
+               only: Callable[[ProgramSpec], bool] | None = None
+               ) -> CompileStats:
     """Drive every dispatched program, SERIALLY.
+
+    ``only`` filters the registry before driving it (e.g. the standing
+    server's CPU compile lane lower-passes everything, then EXECUTES just
+    the ``family == "device"`` programs — the single family the verify
+    worker would otherwise first-trace off the main thread).
 
     mode:
       "lower"   — trace + lower only (--dry-run; CPU-safe, no executable)
@@ -529,6 +619,8 @@ def precompile(profile: Profile = BENCH, mode: str = "compile",
         log = lambda m: print(f"[precompile] {m}", file=sys.stderr,
                               flush=True)
     specs = build_registry(profile)
+    if only is not None:
+        specs = [s for s in specs if only(s)]
     log(f"{len(specs)} programs registered (mode={mode})")
     errors = 0
     for spec in specs:

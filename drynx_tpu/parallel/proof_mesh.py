@@ -173,7 +173,8 @@ def _g1_prep(proof, sigs_pub, r_int):
 
 
 def rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
-                     n_shards: int | None = None):
+                     n_shards: int | None = None,
+                     phase: str = "VerifyShard"):
     """The RLC check's GT total via per-device chunk dispatch (the default
     mesh strategy — see module docstring). Bit-identical to the
     single-device `range_proof.rlc_total_single`: the same bucketed
@@ -184,6 +185,10 @@ def rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
     of the flattened (ns*V*l) digit batch and reduces locally; partials
     combine with one gt_reduce_prod tree, then the single shared final
     exponentiation and gtB power fold in exactly as on one device.
+
+    phase: SHARD_TIMERS span label — the cross-survey scheduler passes
+    "CrossSurveyVerifyShard" so its batched dispatches attribute
+    separately from per-survey "VerifyShard" spans.
     """
     from ..crypto import batching as B
     from . import proof_plane as plane
@@ -213,7 +218,7 @@ def rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
                 B.gt_reduce_prod(ar.reshape(-1, 6, 2, nl)))
 
     parts = plane.dispatch_shards(
-        "VerifyShard", shard_total, [(a, b) for (a, b) in slices])
+        phase, shard_total, [(a, b) for (a, b) in slices])
     # combine partials exactly as the single-device path combines its two
     # full-batch products: final_exp on the Miller product ONLY, then the
     # a-product and the gtB power fold in with plain GT muls
@@ -227,7 +232,8 @@ def rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
 def rlc_verify_sharded(proof, sigs_pub, ca_pub_table,
                        rng: np.random.Generator | None = None, *,
                        mesh=None, n_shards: int | None = None,
-                       strategy: str = "auto") -> bool:
+                       strategy: str = "auto",
+                       phase: str = "VerifyShard") -> bool:
     """Mesh-parallel single-verdict verification of a RangeProofBatch —
     the DEFAULT joint-range path whenever the proof plane is enabled
     (proofs/range_proof.py `_safe_batch_verify` routes here).
@@ -256,7 +262,7 @@ def rlc_verify_sharded(proof, sigs_pub, ca_pub_table,
         total = rlc_total_sharded(mesh, proof, sigs_pub, r_int, gtb_pow_s)
     else:
         total = rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
-                                 n_shards=n_shards)
+                                 n_shards=n_shards, phase=phase)
     return bool(np.asarray(F12.eq(total, jnp.asarray(F12.one()))))
 
 

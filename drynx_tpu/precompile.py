@@ -45,6 +45,10 @@ def main(argv=None) -> int:
                     help="proof-plane shard count; adds the per-shard "
                          "program set (default: the plane's own policy — "
                          "visible devices, DRYNX_PROOF_PLANE override)")
+    ap.add_argument("--queue", type=int, default=1,
+                    help="cross-survey batch width (drynx_tpu/server); "
+                         ">1 adds the cross-survey verify program set at "
+                         "queue-concatenated batch sizes")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -65,7 +69,7 @@ def main(argv=None) -> int:
     profile = cc.Profile(n_cns=args.n_cns, n_dps=args.n_dps,
                          n_values=args.values, u=args.range_u,
                          l=args.range_l, dlog_limit=args.dlog_limit,
-                         n_shards=n_shards)
+                         n_shards=n_shards, n_queue=max(1, args.queue))
 
     if args.list:
         specs = cc.build_registry(profile)

@@ -1274,12 +1274,81 @@ def verify_range_proof_lists_joint(lists: list, ranges, sigs_pub_by_u: dict,
         for i in range(len(lists))]
 
 
+def verify_cross_survey_payloads_joint(payloads_by_sid: dict,
+                                       expected_by_sid: dict,
+                                       sigs_pub_by_u: dict,
+                                       ca_pub_table) -> dict:
+    """Joint verification across SURVEYS: the lists_joint algebra one level
+    up. Every queued survey's structurally-valid batches at the same
+    (u, l) spec concatenate along the value axis into ONE RLC batch check —
+    one shared final exponentiation for the whole queue, not one per survey
+    (sound for the same reason as within-survey batching: the RLC weights
+    are drawn across the whole concatenation and per-value transcripts are
+    independent; bit-identity of the GT algebra is asserted by
+    tests/test_server.py).
+
+    Isolation ladder on a joint failure: fall back to PER-SURVEY joint
+    verification (verify_range_proof_lists_joint), which itself falls back
+    to per-payload — so one tampered survey in the batch costs one retry
+    level, never its neighbours' verdicts. A survey with expected=None
+    (the CN no longer knows it) verifies all-False.
+
+    Returns {survey_id: [bool per payload, in input order]}."""
+    lists_by_sid: dict = {}
+    out = {sid: [False] * len(datas)
+           for sid, datas in payloads_by_sid.items()}
+    for sid, datas in payloads_by_sid.items():
+        if expected_by_sid.get(sid) is None:
+            continue
+        entries = []
+        for i, d in enumerate(datas):
+            try:
+                entries.append((i, RangeProofList.from_bytes(d)))
+            except Exception:
+                from ..utils import log
+
+                log.warn(f"survey {sid} range payload {i}: malformed "
+                         f"bytes, rejected")
+        lists_by_sid[sid] = entries
+
+    ok_struct: dict = {}
+    by_spec: dict = {}
+    for sid, entries in lists_by_sid.items():
+        ranges = expected_by_sid[sid]
+        ok_struct[sid] = {
+            i: _list_structure_ok(lst, ranges, sigs_pub_by_u)
+            for i, lst in entries}
+        for i, lst in entries:
+            if not ok_struct[sid][i]:
+                continue
+            for _ia, pb in lst.batches:
+                by_spec.setdefault((pb.u, pb.l), []).append(pb)
+
+    joint_ok = all(
+        _safe_batch_verify(_concat_batches(pbs), sigs_pub_by_u[u],
+                           ca_pub_table)
+        for (u, _l), pbs in by_spec.items())
+    for sid, entries in lists_by_sid.items():
+        if joint_ok:
+            for i, _lst in entries:
+                out[sid][i] = ok_struct[sid][i]
+        else:
+            ranges = expected_by_sid[sid]
+            verdicts = verify_range_proof_lists_joint(
+                [lst for _i, lst in entries], ranges, sigs_pub_by_u,
+                ca_pub_table)
+            for (i, _lst), ok in zip(entries, verdicts):
+                out[sid][i] = ok
+    return out
+
+
 __all__ = ["RangeSig", "init_range_sig", "sig_gt_table", "to_base",
            "RangeProofBatch",
            "RangeProofList", "group_ranges", "create_range_proofs",
            "create_range_proof_list", "create_range_proof_lists_batched",
            "verify_range_proofs", "verify_range_proofs_batch",
            "verify_range_proof_list", "verify_range_proof_lists_joint",
-           "verify_range_proof_payloads_joint", "rlc_prelude",
+           "verify_range_proof_payloads_joint",
+           "verify_cross_survey_payloads_joint", "rlc_prelude",
            "rlc_total_single", "proof_challenge", "gt_base",
            "gt_base_table", "gt_pow_gtb", "sum_publics_bytes"]

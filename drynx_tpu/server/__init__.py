@@ -1,0 +1,21 @@
+"""Standing survey server: admission control, cross-survey batched
+verification, and a two-stage encode/verify pipeline over LocalCluster.
+
+See SERVER.md for the architecture, the batching algebra, and the
+threading rules the scheduler inherits from the compilecache subsystem.
+"""
+from .admission import (Admission, AdmissionController, AdmissionError,
+                        QueueFull)
+from .scheduler import SurveyServer, pipeline_overlap
+from .transcript import survey_transcript, transcript_digest
+
+__all__ = [
+    "Admission",
+    "AdmissionController",
+    "AdmissionError",
+    "QueueFull",
+    "SurveyServer",
+    "pipeline_overlap",
+    "survey_transcript",
+    "transcript_digest",
+]

@@ -1,0 +1,108 @@
+"""Admission control keyed on the compilecache program registry.
+
+A standing server cannot afford a cold trace+lower+compile inside a timed
+survey (the wall the PR-3 AOT driver exists to kill). Admission therefore
+triages every submitted survey by SHAPE: the query's compile-relevant
+parameters are folded into a ``compilecache.Profile`` and the registry is
+asked which programs that shape dispatches. A shape whose full program set
+has already been driven through the precompile driver this process is
+*warm* and goes to the fast lane; anything else is queued for a cooperative
+compile pass first (scheduler._promote) and only then re-admitted.
+
+The warm set is keyed by PROGRAM NAME (``ProgramSpec.name`` embeds the op
+and the padded bucket, e.g. ``bucketed:miller@4096``), so two different
+query shapes that bucket to the same programs share warmth — exactly the
+dedup the registry itself performs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from .. import compilecache as cc
+from ..parallel import proof_plane as plane
+
+
+class AdmissionError(Exception):
+    """Base class for admission rejections."""
+
+
+class QueueFull(AdmissionError):
+    """The server's bounded queue is at max_depth; resubmit later."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """Triage verdict for one submitted survey."""
+
+    survey_id: str
+    lane: str                     # "fast" | "compile"
+    profile: object = None        # cc.Profile; None for proofs-off surveys
+    missing: tuple = ()           # registry program names not yet warm
+
+
+class AdmissionController:
+    """Shape triage + the process-wide warm-program set.
+
+    ``n_queue`` is the cross-survey batch width the owning scheduler may
+    concatenate at verification time; folding it into the admission
+    profile means a fast-lane verdict certifies the CrossSurveyVerify
+    program set too, so the scheduler can batch any group of fast-lane
+    surveys without risking a cold dispatch on the verify worker.
+    """
+
+    def __init__(self, cluster, n_queue: int = 1):
+        self.cluster = cluster
+        self.n_queue = max(1, n_queue)
+        self._warm: set[str] = set()
+        self._lock = threading.Lock()
+
+    # -- shape derivation --------------------------------------------------
+
+    def profile_for(self, sq) -> cc.Profile | None:
+        """The compile-relevant shape of a survey (None: proofs off, no
+        programs to warm). Mirrors LocalCluster._warm_kernels so the
+        admission key and the AOT driver agree on what 'this shape' means."""
+        q = sq.query
+        if q.proofs != 1 or self.cluster.vns is None:
+            return None
+        ranges = self.cluster._ranges_per_value(q)
+        u0, l0 = ranges[0] if ranges else (16, 5)
+        return cc.Profile(
+            n_cns=len(self.cluster.cns),
+            n_dps=len(self.cluster.dp_idents),
+            n_values=max(len(ranges), 1), u=int(u0) or 16,
+            l=int(l0) or 5, dlog_limit=self.cluster.dlog.limit,
+            n_shards=plane.n_shards(), n_queue=self.n_queue)
+
+    @staticmethod
+    def needed(profile: cc.Profile) -> set[str]:
+        """Names of the programs this shape would dispatch on the current
+        backend (gate-filtered: skipped programs never go cold)."""
+        return {s.name for s in cc.build_registry(profile)
+                if s.dispatched()}
+
+    # -- warm set ----------------------------------------------------------
+
+    def note_warmed(self, profile) -> None:
+        """Record that ``profile``'s program set has been driven through
+        the precompile driver (scheduler._promote / prewarm)."""
+        if profile is None:
+            return
+        names = self.needed(profile)
+        with self._lock:
+            self._warm |= names
+
+    def triage(self, sq) -> Admission:
+        profile = self.profile_for(sq)
+        if profile is None:
+            return Admission(survey_id=sq.survey_id, lane="fast")
+        with self._lock:
+            missing = tuple(sorted(self.needed(profile) - self._warm))
+        return Admission(survey_id=sq.survey_id,
+                         lane="compile" if missing else "fast",
+                         profile=profile, missing=missing)
+
+
+__all__ = ["Admission", "AdmissionController", "AdmissionError",
+           "QueueFull"]

@@ -1,0 +1,304 @@
+"""Standing survey scheduler: bounded lanes, a cooperative compile lane,
+cross-survey batched verification, and a two-stage encode/verify pipeline.
+
+Threading rules (inherited from the r05 segfault class — COMPILECACHE.md):
+
+  * ALL jit tracing stays on the thread that calls ``drain()`` (normally
+    the main thread). The compile lane is "background" only in the
+    scheduling sense: promotion runs the PR-3 precompile driver
+    cooperatively BETWEEN surveys on the drain thread, under the
+    cluster's proof-device lock with trace_guard applied — never on a
+    worker thread.
+  * The single verify worker thread only ever RE-EXECUTES warm programs:
+    a fast-lane verdict certifies the full program set for the shape
+    (including the CrossSurveyVerify concat buckets — admission folds
+    ``n_queue`` into the profile), and on CPU the heavy verify families
+    take the host-oracle detour (pure host compute, no tracing at all).
+    tests/test_server.py hooks ``batching.TRACE_HOOK`` to prove the
+    pipeline never traces off the drain thread. The worker's thread
+    target is a bound method by design — the static thread-trace lint
+    (analysis/rules.py) flags jit first-touch, which this thread cannot
+    perform; see SERVER.md.
+
+Pipelining interleaves *dispatch*: survey N+1's DP encode (drain thread)
+overlaps survey N's VN verification (worker thread). PhaseTimers absolute
+spans (``Pipeline.encode.<sid>`` / ``Pipeline.verify.<sid>``) record the
+overlap; ``pipeline_overlap`` integrates it.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+
+from .. import compilecache as cc
+from ..resilience import policy as rp
+from ..utils import log
+from ..utils.timers import PhaseTimers
+from . import admission as adm
+
+
+@dataclasses.dataclass
+class _Entry:
+    sq: object
+    seed: int
+    admission: adm.Admission
+
+
+# The program set the verify WORKER dispatches as real jits on CPU: the
+# mod-p/mod-n scalar family used by payload deserialization (to_mont_p in
+# _g1/_g2/_gt _from_bytes), the RLC weights (int_to_scalar, fn_*), and the
+# wire encoders. The g1/pairing families host-detour on CPU and everything
+# else dispatches from the drain thread — so executing exactly this set
+# during a lower-mode compile pass keeps the worker trace-free.
+_WORKER_OPS = frozenset({
+    "fn_add", "fn_sub", "fn_neg", "fn_mul_plain", "fn_mont_mul",
+    "int_to_scalar", "to_mont_p", "from_mont_p",
+})
+
+
+class SurveyServer:
+    """A standing scheduler over one LocalCluster.
+
+    ``submit()`` triages surveys into the fast or compile lane (bounded
+    total depth — ``QueueFull`` past ``max_depth``); ``drain()`` processes
+    both lanes to empty on the calling thread and returns per-survey
+    results. Fast-lane surveys with equal shape are grouped (up to
+    ``max_batch``) and their range payloads held at the VNs for ONE
+    cross-survey joint verification; a shape miss costs one cooperative
+    precompile pass, after which the survey is re-admitted.
+
+    ``pipeline=False`` degrades to strictly serial execute+finalize on
+    the drain thread (the reference configuration for transcript
+    comparison); batching still applies.
+    """
+
+    def __init__(self, cluster, max_batch: int = 4, max_depth: int = 16,
+                 pipeline: bool = True, compile_mode: str | None = None):
+        from ..crypto import pallas_ops as po
+
+        self.cluster = cluster
+        self.max_batch = max(1, max_batch)
+        self.max_depth = max(1, max_depth)
+        self.pipeline = pipeline
+        self.admission = adm.AdmissionController(cluster,
+                                                 n_queue=self.max_batch)
+        # "execute" is the only mode that warms dispatch caches, but on
+        # CPU the heavy families host-oracle at dispatch time anyway and
+        # executing the pairing set at opt-level 0 is minutes-scale —
+        # lower-only is the right cooperative unit there (programs land
+        # in the trace cache on the drain thread; the first dispatch
+        # stays serialized under the proof-device lock).
+        self.compile_mode = compile_mode or (
+            "execute" if po.available() else "lower")
+        self.timers = PhaseTimers()
+        self._fast: collections.deque = collections.deque()
+        self._compile: collections.deque = collections.deque()
+        self._results: dict[str, object] = {}
+        self._errors: dict[str, Exception] = {}
+        self._admissions: dict[str, adm.Admission] = {}
+        self._lock = threading.Lock()
+        self._verify_q: queue.Queue = queue.Queue()
+        self._worker: threading.Thread | None = None
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, sq, seed: int = 0) -> adm.Admission:
+        """Triage + enqueue. Raises QueueFull at max_depth (typed
+        rejection — the caller backs off; nothing is dropped silently)."""
+        with self._lock:
+            depth = len(self._fast) + len(self._compile)
+            if depth >= self.max_depth:
+                raise adm.QueueFull(
+                    f"queue at max_depth={self.max_depth}; survey "
+                    f"{sq.survey_id!r} rejected")
+            a = self.admission.triage(sq)
+            self._admissions[sq.survey_id] = a
+            lane = self._compile if a.lane == "compile" else self._fast
+            lane.append(_Entry(sq=sq, seed=seed, admission=a))
+        return a
+
+    def prewarm(self, sq) -> adm.Admission:
+        """Drive the precompile pass for a survey's shape NOW (calling
+        thread) without enqueueing it; returns the post-warm verdict."""
+        a = self.admission.triage(sq)
+        if a.lane == "compile":
+            self._compile_profile(a.profile, sq.survey_id)
+        return self.admission.triage(sq)
+
+    def admission_of(self, survey_id: str) -> adm.Admission | None:
+        return self._admissions.get(survey_id)
+
+    # -- compile lane (cooperative, drain thread only) ---------------------
+
+    def _compile_profile(self, profile, survey_id: str) -> None:
+        t0 = time.perf_counter()
+        with self.cluster._proof_device_lock:
+            cc.trace_guard()
+            cc.precompile(profile, mode=self.compile_mode,
+                          log=lambda m: log.lvl2(f"server compile: {m}"))
+            if self.compile_mode == "lower":
+                # the CPU lane: lowering alone doesn't warm dispatch
+                # caches — execute just the cheap scalar family the
+                # verify worker would otherwise first-trace off this
+                # thread (see _WORKER_OPS)
+                cc.precompile(profile, mode="execute",
+                              only=lambda s: (s.family == "device"
+                                              and s.op in _WORKER_OPS),
+                              log=lambda m: log.lvl2(f"server warm: {m}"))
+        self.timers.span(f"Compile.{survey_id}", t0, time.perf_counter())
+        self.admission.note_warmed(profile)
+
+    def _promote(self, entry: _Entry) -> None:
+        """One cooperative compile-lane step: run the AOT driver for the
+        entry's shape, then re-admit it (now warm) to the fast lane."""
+        sid = entry.sq.survey_id
+        log.lvl2(f"server: compiling shape for {sid} "
+                 f"({len(entry.admission.missing)} cold programs)")
+        self._compile_profile(entry.admission.profile, sid)
+        entry.admission = self.admission.triage(entry.sq)
+        with self._lock:
+            self._admissions[sid] = entry.admission
+            self._fast.append(entry)
+
+    # -- drain loop --------------------------------------------------------
+
+    def drain(self) -> dict:
+        """Process both lanes to empty ON THE CALLING THREAD (the tracing
+        thread), then wait for the verify worker to finish. Returns
+        {survey_id: SurveyResult | Exception}. Fast-lane work always
+        preempts the compile lane, so a cold shape never stalls warm
+        surveys behind its compile pass."""
+        while True:
+            group = None
+            entry = None
+            with self._lock:
+                if self._fast:
+                    group = self._pop_group_locked()
+                elif self._compile:
+                    entry = self._compile.popleft()
+                else:
+                    break
+            if group is not None:
+                self._run_group(group)
+            elif entry is not None:
+                self._promote(entry)
+        self._verify_q.join()
+        return self.results()
+
+    def results(self) -> dict:
+        out: dict = dict(self._results)
+        out.update(self._errors)
+        return out
+
+    def _pop_group_locked(self) -> list:
+        """Maximal run of shape-equal fast-lane entries, up to max_batch.
+        Proofs-off surveys (profile None) never group."""
+        group = [self._fast.popleft()]
+        key = group[0].admission.profile
+        while (key is not None and self._fast
+               and len(group) < self.max_batch
+               and self._fast[0].admission.profile == key):
+            group.append(self._fast.popleft())
+        return group
+
+    # -- encode stage (drain thread) ---------------------------------------
+
+    def _run_group(self, group: list) -> None:
+        hold = len(group) > 1
+        pendings = []
+        for e in group:
+            sid = e.sq.survey_id
+            t0 = time.perf_counter()
+            try:
+                p = self.cluster.execute_survey(e.sq, e.seed,
+                                                hold_range=hold)
+            except Exception as exc:
+                # quorum failure / mid-survey fault: this survey degrades
+                # alone — its batch partners flush without it (a held
+                # survey is only included in the cross flush once ALL its
+                # expected payloads arrived; see flush_ranges_cross)
+                log.warn(f"server: survey {sid} failed in encode: {exc}")
+                self._errors[sid] = exc
+                self.timers.span(f"Pipeline.encode.{sid}",
+                                 t0, time.perf_counter())
+                continue
+            self.timers.span(f"Pipeline.encode.{sid}",
+                             t0, time.perf_counter())
+            pendings.append(p)
+        if not pendings:
+            return
+        if self.pipeline:
+            self._ensure_worker()
+            self._verify_q.put(pendings)
+        else:
+            self._verify_group(pendings)
+
+    # -- verify stage (single worker thread; re-execution only) ------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._verify_loop,
+                                            name="server-verify",
+                                            daemon=True)
+            self._worker.start()
+
+    def _verify_loop(self) -> None:
+        while True:
+            pendings = self._verify_q.get()
+            try:
+                self._verify_group(pendings)
+            except Exception as exc:  # per-survey errors are caught below;
+                log.warn(f"server: verify group crashed: {exc}")
+            finally:
+                self._verify_q.task_done()
+
+    def _verify_group(self, pendings: list) -> None:
+        held = [p for p in pendings if p.hold_range]
+        if held:
+            deadline = time.monotonic() + rp.COLD_COMPILE_WAIT_S
+            for p in held:
+                # all held payloads must be AT the VNs before the joint
+                # flush (on threaded backends proof delivery is async);
+                # joining here is idempotent — finalize joins again
+                for t in p.survey.proof_threads:
+                    t.join(timeout=max(0.0,
+                                       deadline - time.monotonic()))
+            sids = [p.sq.survey_id for p in held]
+            t0 = time.perf_counter()
+            self.cluster.vns.flush_cross_survey(sids)
+            self.timers.span("Pipeline.flush." + "+".join(sids),
+                             t0, time.perf_counter())
+        for p in pendings:
+            sid = p.sq.survey_id
+            t0 = time.perf_counter()
+            try:
+                self._results[sid] = self.cluster.finalize_survey(p)
+            except Exception as exc:
+                log.warn(f"server: survey {sid} failed in verify: {exc}")
+                self._errors[sid] = exc
+            finally:
+                self.timers.span(f"Pipeline.verify.{sid}",
+                                 t0, time.perf_counter())
+
+
+def pipeline_overlap(timers: PhaseTimers) -> float:
+    """Seconds of wall-clock during which some survey's encode span
+    intersects a DIFFERENT survey's verify span — the pipelining proof
+    scripts/serve_surveys.py reports (> 0 iff encode of survey N+1 ran
+    concurrently with verification of survey N)."""
+    encodes = timers.spans("Pipeline.encode.")
+    verifies = timers.spans("Pipeline.verify.")
+    total = 0.0
+    for en, e0, e1 in encodes:
+        e_sid = en.rsplit(".", 1)[-1]
+        for vn, v0, v1 in verifies:
+            if vn.rsplit(".", 1)[-1] == e_sid:
+                continue
+            total += max(0.0, min(e1, v1) - max(e0, v0))
+    return total
+
+
+__all__ = ["SurveyServer", "pipeline_overlap"]

@@ -43,6 +43,7 @@ from ..crypto import batching as B
 from ..crypto import curve as C
 from ..crypto import elgamal as eg
 from ..crypto import refimpl
+from ..analysis import Secret
 from ..encoding import stats as st
 from ..parallel import dro
 from ..proofs import aggregation as agg_proof
@@ -148,7 +149,7 @@ class Roster:
 class DrynxNode:
     """A node process serving its role's handlers."""
 
-    def __init__(self, name: str, secret: int, public: tuple,
+    def __init__(self, name: str, secret: Secret[int], public: tuple,
                  host: str = "127.0.0.1", port: int = 0,
                  data: Optional[np.ndarray] = None,
                  db_path: Optional[str] = None,
@@ -478,10 +479,14 @@ class DrynxNode:
         u_pts = B.fixed_base_mul(eg.BASE_TABLE.table, rs)
         rQ = B.fixed_base_mul(q_tbl.table, rs)
         xK = B.g1_scalar_mul(K0, x)
-        w_pts = B.g1_add(rQ, B.g1_neg(xK))
+        # the switched component w = rQ - xK is ciphertext — a public
+        # protocol output even though the secret key went into it
+        w_pts = B.g1_add(rQ, B.g1_neg(xK))  # drynx: declassify[secret]
         if msg.get("proofs"):
             key2 = jax.random.PRNGKey(secrets.randbits(63))
-            pr = ks_proof.create_keyswitch_proofs(
+            # a ZK proof transcript (commitments + responses) is public
+            # by construction; x is an input, never serialized
+            pr = ks_proof.create_keyswitch_proofs(  # drynx: declassify[secret]
                 key2, K0, x[None], rs[None],
                 jnp.asarray(C.from_ref(client_pub)), q_tbl.table,
                 jnp.asarray(u_pts)[None], jnp.asarray(w_pts)[None])

@@ -42,6 +42,11 @@ class SurveyProofState:
     expected_range: int = 0
     pending_range: dict = dataclasses.field(default_factory=dict)
     range_flushed: bool = False
+    # cross-survey batching (server/ scheduler): when held, reaching the
+    # flush threshold does NOT trigger the per-survey joint verify — the
+    # scheduler flushes several held surveys in ONE cross-survey RLC via
+    # flush_ranges_cross (same algebra one level up)
+    hold_range: bool = False
 
 
 # One payload verification at a time per process: VN handler threads (a
@@ -152,10 +157,12 @@ class VerifyingNode:
     # -- reference HandleSurveyQueryToVN (service_skipchain.go:31-93)
     def register_survey(self, survey_id: str, expected_proofs: int,
                         thresholds: dict[str, float],
-                        expected_range: int = 0) -> None:
+                        expected_range: int = 0,
+                        hold_range: bool = False) -> None:
         with self._lock:
             self.surveys[survey_id] = SurveyProofState(
-                expected=expected_proofs, expected_range=expected_range)
+                expected=expected_proofs, expected_range=expected_range,
+                hold_range=hold_range)
             self.thresholds = getattr(self, "thresholds", {})
             self.thresholds[survey_id] = thresholds
 
@@ -232,7 +239,8 @@ class VerifyingNode:
                 return st.bitmap.get(req.storage_key(), rq.BM_RECVD)
             st.pending_range[req.storage_key()] = (req, sampled, bad_sig)
             pending = None
-            if len(st.pending_range) >= st.expected_range:
+            if (not st.hold_range
+                    and len(st.pending_range) >= st.expected_range):
                 st.range_flushed = True
                 pending = dict(st.pending_range)
         if pending is None:
@@ -293,6 +301,101 @@ class VerifyingNode:
                   f"{time.perf_counter() - t0:.3f}s", file=sys.stderr,
                   flush=True)
 
+    def range_ready(self, survey_id: str) -> bool:
+        """True once every expected range payload is buffered (or the
+        survey needs no joint flush) — the scheduler's batching gate."""
+        st = self.surveys.get(survey_id)
+        if st is None:
+            return False
+        with self._lock:
+            if st.expected_range <= 1 or st.range_flushed:
+                return True
+            return len(st.pending_range) >= st.expected_range
+
+    def flush_ranges_cross(self, survey_ids: list) -> list:
+        """Flush several HELD surveys' buffered range payloads in ONE
+        cross-survey joint verification (verify_fns["range_cross"]).
+
+        The per-survey joint flush already amortizes the RLC + final exp
+        across one survey's DP payloads; this applies the same algebra one
+        level up, across queued surveys — one shared final exponentiation
+        for the whole batch, per-survey verdicts split back out by the
+        cross fn. Falls back to per-survey joint flushes when no cross fn
+        is installed. Per-survey exception containment is preserved: a
+        crash in the cross verify records all-False for every survey in
+        THIS flush only (never memoized), exactly like _flush_range.
+        Returns the survey ids actually flushed here (ready + unflushed)."""
+        cross = self.verify_fns.get("range_cross")
+        joint = self.verify_fns.get("range_joint")
+        snap: dict[str, dict] = {}
+        with self._lock:
+            for sid in survey_ids:
+                st = self.surveys.get(sid)
+                if st is None or st.range_flushed or st.expected_range <= 0:
+                    continue
+                if len(st.pending_range) < st.expected_range:
+                    continue     # not ready; scheduler retries later
+                st.range_flushed = True
+                snap[sid] = dict(st.pending_range)
+        if not snap:
+            return []
+        if cross is None:
+            for sid, pending in snap.items():
+                self._flush_range(self.surveys[sid], sid, pending, joint)
+            return list(snap)
+        t0 = time.perf_counter()
+        keys_by_sid = {sid: sorted(p) for sid, p in snap.items()}
+        to_verify = {sid: [k for k in keys_by_sid[sid] if snap[sid][k][1]]
+                     for sid in snap}
+        payloads = {sid: [snap[sid][k][0].data for k in to_verify[sid]]
+                    for sid in snap if to_verify[sid]}
+
+        def compute():
+            with _VERIFY_DEVICE_LOCK:
+                return cross(payloads)
+
+        verdicts_by_sid: dict[str, list] = {}
+        if payloads:
+            import hashlib
+
+            h = hashlib.sha256()
+            for sid in sorted(payloads):
+                h.update(sid.encode())
+                for data in payloads[sid]:
+                    h.update(hashlib.sha256(data).digest())
+            try:
+                verdicts_by_sid = self.verify_cache.get_or_compute(
+                    ("range_cross", h.digest()), compute)
+            except Exception:
+                import traceback
+
+                log.warn(f"VN {self.name}: cross-survey range verify "
+                         f"raised: {traceback.format_exc(limit=8)}")
+                verdicts_by_sid = {sid: [False] * len(payloads[sid])
+                                   for sid in payloads}
+        for sid, pending in snap.items():
+            st = self.surveys[sid]
+            verdicts = dict(zip(to_verify[sid],
+                                verdicts_by_sid.get(sid, [])))
+            for k in keys_by_sid[sid]:
+                r, was_sampled, was_bad = pending[k]
+                if was_bad:
+                    continue  # BM_BADSIG already recorded at arrival
+                code = (rq.BM_TRUE if verdicts.get(k)
+                        else rq.BM_FALSE) if was_sampled else rq.BM_RECVD
+                self._record(st, k, r.data, code)
+        from ..utils.timers import PhaseTimers
+
+        if PhaseTimers.echo:
+            import sys
+
+            n_pay = sum(len(v) for v in payloads.values())
+            print(f"    [vn] {self.name} CROSS-SURVEY range verify of "
+                  f"{n_pay} payloads across {len(snap)} surveys: "
+                  f"{time.perf_counter() - t0:.3f}s", file=sys.stderr,
+                  flush=True)
+        return list(snap)
+
     def adjust_expected(self, survey_id: str, drop: int,
                         expected_range: Optional[int] = None) -> None:
         """Quorum-degraded survey: the root CN reports that ``drop`` DPs
@@ -311,7 +414,8 @@ class VerifyingNode:
             st.expected = max(0, st.expected - int(drop))
             if expected_range is not None:
                 st.expected_range = int(expected_range)
-            if (not st.range_flushed and joint is not None
+            if (not st.range_flushed and not st.hold_range
+                    and joint is not None
                     and 0 < st.expected_range <= len(st.pending_range)):
                 st.range_flushed = True
                 pending = dict(st.pending_range)
@@ -347,14 +451,44 @@ class VNGroup:
 
     def register_survey(self, survey_id: str, expected_proofs: int,
                         thresholds: dict[str, float],
-                        expected_range: int = 0) -> None:
+                        expected_range: int = 0,
+                        hold_range: bool = False) -> None:
         for vn in self.vns:
             vn.register_survey(survey_id, expected_proofs, thresholds,
-                               expected_range=expected_range)
+                               expected_range=expected_range,
+                               hold_range=hold_range)
 
-    def deliver(self, req: rq.ProofRequest) -> list[int]:
-        """Star fan-out: every VN receives and verifies the proof."""
-        return [vn.receive_proof(req) for vn in self.vns]
+    def deliver(self, req: rq.ProofRequest) -> list:
+        """Star fan-out: every VN receives and verifies the proof.
+
+        Each VN's delivery rides transport.local_call, so an active
+        FaultPlan can kill/pause/delay individual VNs on the in-process
+        path too: a faulted VN simply never sees the proof (its slot in
+        the returned list is None) and its counter stays up — exactly the
+        straggler the vn_quorum path in end_verification tolerates."""
+        from . import transport as tr
+
+        codes: list = []
+        for vn in self.vns:
+            try:
+                codes.append(tr.local_call(vn.name, req.proof_type,
+                                           vn.receive_proof, req))
+            except tr.TransportError as e:
+                log.warn(f"VN {vn.name}: delivery faulted: {e}")
+                codes.append(None)
+        return codes
+
+    def flush_cross_survey(self, survey_ids: list) -> list:
+        """Cross-survey joint flush on every VN (held surveys only). The
+        shared per-process VerifyCache makes VN 2..n cache hits for the
+        byte-identical batch; distributed VNs each verify independently.
+        Returns the root VN's flushed-survey list."""
+        out = []
+        for vn in self.vns:
+            flushed = vn.flush_ranges_cross(survey_ids)
+            if vn is self.root:
+                out = flushed
+        return out
 
     def end_verification(self, survey_id: str,
                          timeout: float = rp.VN_GROUP_WAIT_S,

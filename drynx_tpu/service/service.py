@@ -220,6 +220,25 @@ class LocalCluster:
             return rproof.verify_range_proof_payloads_joint(
                 datas, expected, sigs_pub_by_u, self.coll_tbl.table)
 
+        def vrange_cross(payloads_by_sid: dict) -> dict:
+            # cross-survey joint RLC (server/ scheduler): amortizes the RLC
+            # + shared final exponentiation across every QUEUED survey at
+            # equal bucket shapes, not just within one survey. A survey the
+            # CN no longer knows verifies False (same containment as
+            # vrange_joint's unknown-survey arm).
+            expected_by_sid = {}
+            for sid in payloads_by_sid:
+                survey = self.surveys.get(sid)
+                expected_by_sid[sid] = (
+                    None if survey is None
+                    else self._ranges_per_value(survey.sq.query))
+            sigs_pub_by_u = {
+                u: [s.public for s in sigs]
+                for u, sigs in self.range_sigs.items()}
+            return rproof.verify_cross_survey_payloads_joint(
+                payloads_by_sid, expected_by_sid, sigs_pub_by_u,
+                self.coll_tbl.table)
+
         def vagg(data: bytes, _sid: str) -> bool:
             from ..proofs.safe_pickle import safe_loads
 
@@ -266,8 +285,26 @@ class LocalCluster:
                         sv.timers.add("AllProofs", dt)
             return wrapped
 
+        def _timed_cross(fn):
+            # the cross fn's cost is split evenly across the batched
+            # surveys' timers (one dispatch serves them all)
+            def wrapped(payloads_by_sid, _fn=fn):
+                t0 = time.perf_counter()
+                try:
+                    return _fn(payloads_by_sid)
+                finally:
+                    dt = time.perf_counter() - t0
+                    share = dt / max(1, len(payloads_by_sid))
+                    for sid in payloads_by_sid:
+                        sv = self.surveys.get(sid)
+                        if sv is not None:
+                            sv.timers.add("VerifyRange", share)
+                            sv.timers.add("AllProofs", share)
+            return wrapped
+
         return {"range": _timed("VerifyRange", vrange),
                 "range_joint": _timed("VerifyRange", vrange_joint),
+                "range_cross": _timed_cross(vrange_cross),
                 "aggregation": _timed("VerifyAggregation", vagg),
                 "obfuscation": _timed("VerifyObfuscation", vobf),
                 "keyswitch": _timed("VerifyKeySwitch", vks),
@@ -283,7 +320,12 @@ class LocalCluster:
                               lr_params=None, thresholds: float = 1.0,
                               cutting_factor: int = 0,
                               group_by=None, min_dp_quorum: int = 0,
-                              vn_quorum: float = 1.0) -> SurveyQuery:
+                              vn_quorum: float = 1.0,
+                              survey_id: Optional[str] = None) -> SurveyQuery:
+        # survey_id: callers needing reproducible ids (the serial-vs-batched
+        # bit-identity comparison in scripts/serve_surveys.py re-runs the
+        # SAME surveys through two schedulers) pass one explicitly; the
+        # default stays collision-resistant random.
         op = choose_operation(op_name, query_min, query_max, dims,
                               cutting_factor, lr_params)
         if group_by and op_name == "log_reg":
@@ -312,7 +354,7 @@ class LocalCluster:
                   and not all(u == 0 and l == 0 for (u, l) in ranges),
                   group_by=group_by)
         sq = SurveyQuery(
-            survey_id=f"survey-{secrets.token_hex(4)}",
+            survey_id=survey_id or f"survey-{secrets.token_hex(4)}",
             query=q,
             server_ids=[c.name for c in self.cns],
             server_to_dp=self.server_to_dp,
@@ -477,6 +519,16 @@ class LocalCluster:
     # The full survey (reference SendSurveyQuery path, SURVEY.md §3.1)
     # ------------------------------------------------------------------
     def run_survey(self, sq: SurveyQuery, seed: int = 0):
+        return self.finalize_survey(self.execute_survey(sq, seed))
+
+    def execute_survey(self, sq: SurveyQuery, seed: int = 0,
+                       hold_range: bool = False):
+        """Phases through decrypt+decode; returns a PendingSurvey whose
+        proof verification has not been finalized. run_survey composes this
+        with finalize_survey; the standing scheduler (drynx_tpu.server)
+        splits them so survey N+1's encode overlaps survey N's verify, and
+        passes hold_range=True so queued surveys' range payloads buffer at
+        the VNs for ONE cross-survey joint flush."""
         survey = Survey(sq)
         self.surveys[sq.survey_id] = survey
         q = sq.query
@@ -485,20 +537,30 @@ class LocalCluster:
         key = jax.random.PRNGKey(seed)
         proofs_on = q.proofs == 1 and self.vns is not None
 
-        # --- Quorum-degraded membership: an active FaultPlan's node kills
-        # are the in-process equivalent of a DP that never answers the TCP
-        # dispatch (service/node.py _h_survey_query). The survey proceeds
-        # over the responders iff they meet min_dp_quorum, and the VN
+        # --- Quorum-degraded membership: with an active FaultPlan every
+        # DP dispatch rides transport.local_call, so the in-process path
+        # sees the same connect/request/node hooks as a TCP dispatch
+        # (service/node.py _h_survey_query): a killed, refusing, or
+        # dropped DP is simply absent. The survey proceeds over the
+        # responders iff they meet min_dp_quorum, and the VN
         # expected-proof counters are sized to the responder set.
         plan = faults.fault_plan()
-        dp_idents = list(self.dp_idents)
+        dp_idents: list = []
         absent: list[str] = []
         if plan is not None:
-            # DP names are public routing metadata even though the
-            # identity objects also carry the node's secret scalar
-            absent = [d.name  # drynx: declassify[secret]
-                      for d in dp_idents if plan.killed(d.name)]
-            dp_idents = [d for d in dp_idents if d.name not in absent]
+            from . import transport as tr
+
+            for d in self.dp_idents:
+                # DP names are public routing metadata even though the
+                # identity objects also carry the node's secret scalar
+                name = d.name  # drynx: declassify[secret]
+                try:
+                    tr.local_call(name, "survey_query", lambda: None)
+                    dp_idents.append(d)
+                except tr.TransportError:
+                    absent.append(name)
+        else:
+            dp_idents = list(self.dp_idents)
         responders = [d.name for d in dp_idents]
         need = (sq.min_dp_quorum if sq.min_dp_quorum > 0
                 else len(self.dp_idents))
@@ -523,7 +585,8 @@ class LocalCluster:
                  "aggregation": sq.aggregation_proof_threshold,
                  "obfuscation": sq.obfuscation_proof_threshold,
                  "keyswitch": sq.key_switching_proof_threshold},
-                expected_range=nbrs[0] - len(absent))
+                expected_range=nbrs[0] - len(absent),
+                hold_range=hold_range)
             # first-touch tracing of the proofs-on kernel set happens HERE,
             # on the main thread, before any proof worker thread exists
             self._warm_kernels(tm, q)
@@ -749,25 +812,48 @@ class LocalCluster:
                                dims=(op.nbr_input - 1)
                                if op.name == "lin_reg" else 1)
 
-        # --- VN finalization --------------------------------------------
+        return PendingSurvey(survey=survey, sq=sq, result=result,
+                             decrypted=dec, responders=responders,
+                             absent=sorted(absent), proofs_on=proofs_on,
+                             hold_range=hold_range)
+
+    def finalize_survey(self, pending: "PendingSurvey"):
+        """Join the survey's proof threads, end VN verification, and
+        commit the audit block (the back half of run_survey)."""
+        # a PendingSurvey aggregates the decode output (secret-derived
+        # result/decrypted fields) with public bookkeeping; the Survey
+        # record and its SurveyQuery are caller-visible metadata, not key
+        # material — their object-level taint is an artifact of riding in
+        # the same dataclass as the decode output
+        survey, sq = pending.survey, pending.sq  # drynx: declassify[secret]
+        sid = sq.survey_id
+        tm = survey.timers
         block = None
-        if proofs_on:
+        if pending.proofs_on:
             # generous: on a cold CPU process the proof threads' FIRST run
             # includes all pairing-kernel compiles (tens of minutes at
             # opt-level 0 on one core; seconds on TPU)
             for t in survey.proof_threads:
                 t.join(timeout=rp.COLD_COMPILE_WAIT_S)
+            if pending.hold_range:
+                # safety release: a held survey reaching finalization
+                # without the scheduler's cross-survey flush (e.g. its
+                # batch partners all faulted away) flushes solo here —
+                # otherwise end_verification would stall out its timeout
+                self.vns.flush_cross_survey([sid])
             block = self.vns.end_verification(
-                sq.survey_id, timeout=rp.COLD_COMPILE_WAIT_S,
+                sid, timeout=rp.COLD_COMPILE_WAIT_S,
                 quorum=sq.vn_quorum)
-            log.lvl2(f"survey {sq.survey_id}: audit block "
+            log.lvl2(f"survey {sid}: audit block "
                      f"#{block.index} committed, "
                      f"{len(block.data.bitmap)} bitmap entries")
-        log.lvl1(f"survey {sq.survey_id}: done; phases: " + ", ".join(
+        log.lvl1(f"survey {sid}: done; phases: " + ", ".join(
             f"{k}={v:.3f}s" for k, v in tm.items()))
-        return SurveyResult(result=result, decrypted=dec, block=block,
-                            timers=tm, survey_id=sq.survey_id,
-                            responders=responders, absent=sorted(absent))
+        return SurveyResult(result=pending.result,
+                            decrypted=pending.decrypted, block=block,
+                            timers=tm, survey_id=sid,
+                            responders=pending.responders,
+                            absent=pending.absent)
 
     # ------------------------------------------------------------------
     def _async_proof(self, survey: Survey, ptype: str, ident: NodeIdentity,
@@ -869,6 +955,20 @@ def _fused_dec(switched, qx, keys, xs, ysign, vals):
 
 
 @dataclasses.dataclass
+class PendingSurvey:
+    """A survey that ran through decrypt+decode but whose proof
+    verification is not yet finalized (execute_survey/finalize_survey)."""
+    survey: Survey
+    sq: SurveyQuery
+    result: object
+    decrypted: st.DecryptedVector
+    responders: list
+    absent: list
+    proofs_on: bool
+    hold_range: bool = False
+
+
+@dataclasses.dataclass
 class SurveyResult:
     result: object
     decrypted: st.DecryptedVector
@@ -906,4 +1006,5 @@ def _limbs_to_int(limbs: np.ndarray) -> int:
     return params.from_limbs(limbs)
 
 
-__all__ = ["NodeIdentity", "DataProvider", "LocalCluster", "SurveyResult"]
+__all__ = ["NodeIdentity", "DataProvider", "LocalCluster", "SurveyResult",
+           "PendingSurvey"]

@@ -390,8 +390,73 @@ class Conn:
         self.sock.close()
 
 
+def local_call(peer: str, mtype: str, fn, *args, **kwargs):
+    """Run an in-process node call under the same FaultPlan hooks a TCP
+    exchange would hit (the open resilience next-step from ROBUSTNESS.md).
+
+    LocalCluster never opens sockets, so before this helper the four
+    transport hooks only fired on the TCP path — a soak test against the
+    in-process scheduler could not kill/pause/delay nodes. ``local_call``
+    replays the hook consultation order of Conn.__init__ + Conn.call +
+    the NodeServer handler against the in-process callable:
+
+      connect  — killed/kill-node -> ConnectError; refuse -> ConnectError;
+                 delay -> sleep then proceed.
+      request  — drop -> CallTimeout (the frame vanished; a socket caller
+                 would block out its timeout — modeled immediately so the
+                 soak stays fast); corrupt/close_mid_frame ->
+                 ConnectionClosed; delay -> sleep then proceed.
+      node     — pause -> sleep delay_s then proceed (kill handled above).
+      reply    — same frame semantics as request, applied after fn ran
+                 (the node did the work; only the answer is lost).
+
+    With no plan active the overhead is one ``fault_plan()`` read.
+    """
+    plan = faults.fault_plan()
+    if plan is None:
+        return fn(*args, **kwargs)
+    if plan.killed(peer):
+        raise ConnectError(f"connect to {peer} refused "
+                           f"(fault plan: node killed)")
+    act = plan.pick("connect", peer)
+    if act is not None:
+        if act.kind == "refuse":
+            raise ConnectError(f"connect to {peer} refused (fault plan)")
+        if act.kind == "delay":
+            time.sleep(act.delay_s)
+    act = plan.pick("request", peer, mtype)
+    if act is not None:
+        if act.kind == "drop":
+            raise CallTimeout(
+                f"timeout mid-call to {peer} ({mtype!r}); "
+                f"request dropped (fault plan)")
+        if act.kind in ("corrupt", "close_mid_frame"):
+            raise ConnectionClosed(
+                f"connection to {peer} lost mid-request of {mtype!r} "
+                f"(fault plan: {act.kind})")
+        if act.kind == "delay":
+            time.sleep(act.delay_s)
+    nf = plan.node_fault(peer)
+    if nf is not None and nf.kind == "kill":
+        raise ConnectError(f"connect to {peer} refused "
+                           f"(fault plan: node killed)")
+    if nf is not None and nf.kind == "pause":
+        time.sleep(nf.delay_s)
+    out = fn(*args, **kwargs)
+    act = plan.pick("reply", peer, mtype)
+    if act is not None:
+        if act.kind in ("drop", "corrupt", "close_mid_frame"):
+            raise ConnectionClosed(
+                f"reply from {peer} lost for {mtype!r} "
+                f"(fault plan: {act.kind})")
+        if act.kind == "delay":
+            time.sleep(act.delay_s)
+    return out
+
+
 __all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
            "recv_msg", "NodeServer", "Conn", "LinkModel", "link_model",
            "set_link_model", "set_max_frame_bytes", "MAX_FRAME_BYTES",
+           "local_call",
            "TransportError", "ConnectError", "ConnectionClosed",
            "CallTimeout", "FrameTooLarge", "CorruptFrame", "RemoteError"]

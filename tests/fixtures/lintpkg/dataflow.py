@@ -1,4 +1,4 @@
-"""Value-dataflow fixture: exactly TWO violations, one per dataflow rule.
+"""Value-dataflow fixture: exactly FOUR violations across the two rules.
 
 * ``launder_roundtrip`` — a uint32 limb array is pinned, flattened
   through a pytree, laundered to float32 in the transform, repacked and
@@ -7,16 +7,26 @@
   one ``secret-flow-to-sink``. The identifier is deliberately ``sk`` so
   the regex ``secret-logging`` seed rule fires on the same line — the
   dedupe test asserts the dataflow finding absorbs it (one report).
+* ``annotated_leak`` — a ``Secret[int]`` *annotated* parameter (no
+  definition-site seed in scope) reaches ``log.warning``: one
+  ``secret-flow-to-sink`` from the annotation seed.
+* ``batch_leak`` — a nonce is ``.append``-ed into a list and the LIST is
+  logged: one ``secret-flow-to-sink`` through the container mutation
+  (no assignment statement ever touches the binding).
 
 The ``*_ok`` twins are the negative cases: re-pinning the dtype at the
-pytree boundary clears the launder taint, and logging only the public
-survey id is fine.
+pytree boundary clears the launder taint, logging only the public survey
+id is fine, hashing an annotated secret declassifies it, and a container
+that only ever held public values stays public.
 """
+import hashlib
 import logging
 import secrets
 
 import jax
 import jax.numpy as jnp
+
+from drynx_tpu.analysis import Secret
 
 log = logging.getLogger("lintpkg.dataflow")
 
@@ -53,3 +63,28 @@ def announce_ok(survey_id):
     sk = secrets.randbelow(1 << 16)
     log.info("survey %s started", survey_id)
     return sk
+
+
+def annotated_leak(survey_id, node_key: Secret[int]):
+    log.warning("survey %s key %d", survey_id, node_key)
+    return node_key
+
+
+def annotated_leak_ok(survey_id, node_key: Secret[int]):
+    fp = hashlib.sha256(str(node_key).encode()).hexdigest()
+    log.warning("survey %s key fingerprint %s", survey_id, fp)
+    return node_key
+
+
+def batch_leak(survey_id):
+    pending = [survey_id]
+    pending.append(secrets.randbelow(1 << 16))
+    log.info("pending batch: %s", pending)
+    return pending
+
+
+def batch_leak_ok(survey_id):
+    pending = [survey_id]
+    pending.append(len(str(survey_id)))
+    log.info("pending batch: %s", pending)
+    return pending

@@ -175,6 +175,64 @@ def test_hash_and_declassify_marker_scrub_secrecy():
     assert [r.line for r in df.secret_raw] == [6]
 
 
+ANNOTATED = """
+    from drynx_tpu.analysis import Secret
+
+    def leak_param(sk: Secret[int]):
+        print(sk)
+
+    def leak_param_str(sk: "Secret[int]"):
+        print(sk)
+
+    def leak_local(blob):
+        key: Secret[bytes] = blob[0]
+        print(key)
+
+    def hashed(sk: Secret[int]):
+        print(hash(sk))
+"""
+
+
+def test_secret_annotation_seeds_params_and_bindings():
+    _, df = build([(SERVICE, ANNOTATED)])
+    # leak_param (5), leak_param_str (8, string-literal form), leak_local
+    # (12, AnnAssign binding); hashed() declassifies through hash()
+    assert sorted(r.line for r in df.secret_raw) == [5, 8, 12], df.secret_raw
+    assert any("annotated parameter 'sk'" in r.chain[0]
+               for r in df.secret_raw)
+    assert any("annotated binding" in hop
+               for r in df.secret_raw for hop in r.chain)
+
+
+MUTATED = """
+    import secrets
+
+    def leak_batch():
+        batch = []
+        batch.append(secrets.randbelow(9))
+        print(batch)
+
+    def ok_batch(x):
+        batch = []
+        batch.append(len(x))
+        print(batch)
+
+    def leak_update():
+        d = {}
+        d.update(k=secrets.randbelow(9))
+        print(d)
+"""
+
+
+def test_container_mutation_carries_secrecy_to_the_binding():
+    _, df = build([(SERVICE, MUTATED)])
+    # .append (7) and .update-kwarg (17) both taint the container binding;
+    # ok_batch's len() stays public
+    assert sorted(r.line for r in df.secret_raw) == [7, 17], df.secret_raw
+    assert any("into container 'batch'" in hop
+               for r in df.secret_raw for hop in r.chain)
+
+
 INTERPROC = """
     import secrets
 
@@ -240,7 +298,9 @@ def test_fixture_sarif_matches_golden():
 def test_dataflow_finding_absorbs_regex_secret_logging():
     proc = _cli([str(FIXTURE), "--no-baseline"])
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert proc.stdout.count("[secret-flow-to-sink]") == 1
+    # announce + annotated_leak + batch_leak; the regex seed rule only
+    # ever fired on announce's `sk` line and is absorbed there
+    assert proc.stdout.count("[secret-flow-to-sink]") == 3
     assert "[secret-logging]" not in proc.stdout
     # the seed rule is still alive on its own (regression guard for the
     # absorb mechanism, not a tautology)
