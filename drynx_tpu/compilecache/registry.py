@@ -58,6 +58,16 @@ class Profile:
     n_queue: int = 1        # cross-survey batch width (drynx_tpu/server):
                             # >1 adds the cross-survey verify program set
                             # at n_queue-concatenated batch sizes
+    n_buckets: int = 0      # bucket-grid width of a grid-op survey
+                            # (min/max/frequency_count/union/inter:
+                            # n_values == n_buckets, ranges (u=2, l=1)).
+                            # Above encoding/tiles.TILE_THRESHOLD the
+                            # tiled dispatch path engages and adds the
+                            # tile-shard program set (_bucket_schemas)
+                            # plus fused enc at tile slab widths. 0 (the
+                            # default) = non-grid survey, no extra
+                            # programs, so plain registries stay a subset
+                            # of bucket-grid ones (test_precompile.py).
 
 
 BENCH = Profile()
@@ -360,6 +370,73 @@ def _shard_schemas(p: Profile) -> list:
     ]
 
 
+def _bucket_schemas(p: Profile) -> list:
+    """The bucket-tile program set of a grid-op survey (min/max/
+    frequency_count/union/inter). Above encoding/tiles.TILE_THRESHOLD the
+    create path tiles its commit stage: proofs/range_proof.
+    create_range_proofs dispatches _commit_kernel_sharded with
+    k = max(n_shards, tiles.proof_tile_shards(V, tiles.tile_width()))
+    over the dp-flattened value axis V = n_dps * n_buckets (for grid ops
+    every bucket is one (u=2, l=1) value, so n_values == n_buckets).
+    Same bucketed ops as the creation-shard family, at the tile-derived
+    per-shard batch sizes. Empty when n_buckets <= 0 or the grid sits
+    below the tile threshold, so plain registries are a subset of
+    bucket-grid ones (tests/test_precompile.py enforces both
+    directions, mirroring the n_shards / n_queue contracts)."""
+    if p.n_buckets <= 0:
+        return []
+    from ..encoding import tiles as _tiles
+
+    V = p.n_dps * p.n_buckets
+    t = _tiles.auto_tile(V)
+    if not t:
+        return []
+    k = max(p.n_shards, _tiles.proof_tile_shards(V, t))
+    if k <= 1:
+        return []
+
+    def cdiv(a, kk):
+        return -(-a // kk)
+
+    # tile shard: slice of the dp-flattened bucket-value axis
+    ts = lambda p: cdiv(p.n_dps * p.n_buckets, k)
+    tsl = lambda p: ts(p) * p.l
+    ntsl = lambda p: p.n_cns * ts(p) * p.l
+    return [
+        ("fn_add", lambda p, b: (_scalar(b), _scalar(b)),
+         [ts], "RangeProofCreateTile", "device"),
+        ("fn_neg", lambda p, b: (_scalar(b),),
+         [tsl, ntsl], "RangeProofCreateTile", "device"),
+        ("fn_mul_plain", lambda p, b: (_scalar(b), _scalar(b)),
+         [ntsl], "RangeProofCreateTile", "device"),
+        ("fn_mont_mul", lambda p, b: (_scalar(b), _scalar(b)),
+         [tsl], "RangeProofCreateTile", "device"),
+        ("int_to_scalar", lambda p, b: (_i64(b),),
+         [tsl], "RangeProofCreateTile", "device"),
+        ("fixed_base_mul", lambda p, b: (_fb_table(), _scalar(b)),
+         [ts, tsl], "RangeProofCreateTile", "g1"),
+        ("g1_add", lambda p, b: (_g1(b), _g1(b)),
+         [ts], "RangeProofCreateTile", "g1"),
+        ("g1_normalize", lambda p, b: (_g1(b),),
+         [tsl], "RangeProofCreateTile", "g1"),
+        ("g2_scalar_mul", lambda p, b: (_g2(b), _scalar(b)),
+         [ntsl], "RangeProofCreateTile", "g1"),
+        ("g2_normalize", lambda p, b: (_g2(b),),
+         [ntsl], "RangeProofCreateTile", "g1"),
+        ("pair", lambda p, b: (_coord(b), _coord(b), _fp2c(b), _fp2c(b)),
+         [ntsl], "RangeProofCreateTile", "pairing"),
+        ("gt_pow", lambda p, b: (_gt(b), _scalar(b)),
+         [ntsl], "RangeProofCreateTile", "pairing"),
+        ("gt_mul", lambda p, b: (_gt(b), _gt(b)),
+         [ntsl], "RangeProofCreateTile", "pairing"),
+        ("gt_pow_fixed_multi",
+         lambda p, b: (_pow_tables(p), _z((b,), "int32"), _scalar(b)),
+         [ntsl], "RangeProofCreateTile", "pallas"),
+        ("gt_pow_gtb", lambda p, b: (_scalar(b),),
+         [tsl], "RangeProofCreateTile", "pallas"),
+    ]
+
+
 def _queue_schemas(p: Profile) -> list:
     """The cross-survey verify program set of the standing survey server
     (drynx_tpu/server): `n_queue` equal-shape surveys' joint digit batches
@@ -488,17 +565,21 @@ def _fused_specs(p: Profile) -> list:
     survey shapes run_survey dispatches."""
     V, nd, nc, T = p.n_values, p.n_dps, p.n_cns, 2 * p.dlog_limit
 
-    def enc(do="lower"):
-        import jax.numpy as jnp
-        import numpy as np
+    def enc_at(w):
+        def go(do="lower"):
+            import jax.numpy as jnp
+            import numpy as np
 
-        from ..service import service as svc
+            from ..service import service as svc
 
-        args = (_fb_table(),
-                jnp.asarray(np.zeros((nd, V), dtype=np.int64)),
-                _z((nd, V, NL)))
-        return (svc._fused_enc(*args) if do == "call"
-                else svc._fused_enc.lower(*args))
+            args = (_fb_table(),
+                    jnp.asarray(np.zeros((nd, w), dtype=np.int64)),
+                    _z((nd, w, NL)))
+            return (svc._fused_enc(*args) if do == "call"
+                    else svc._fused_enc.lower(*args))
+        return go
+
+    enc = enc_at(V)
 
     def agg(do="lower"):
         from ..service import service as svc
@@ -528,8 +609,26 @@ def _fused_specs(p: Profile) -> list:
     mk = lambda nm, th, ph: ProgramSpec(f"fused:{nm}", nm, "fused", ph, th,
                                         lambda: True,
                                         lambda th=th: th("call"))
-    return [mk("enc", enc, "DataCollection"), mk("agg", agg, "Aggregation"),
-            mk("ks", ks, "KeySwitching"), mk("dec", dec, "Decryption")]
+    specs = [mk("enc", enc, "DataCollection"),
+             mk("agg", agg, "Aggregation"),
+             mk("ks", ks, "KeySwitching"), mk("dec", dec, "Decryption")]
+    if p.n_buckets > 0:
+        # chunked encrypt of a grid survey: service.execute_survey slabs
+        # the (nd, n_buckets) stats through _fused_enc at plan_tiles
+        # widths (balanced tiling => at most 2 distinct widths)
+        from ..encoding import tiles as _tiles
+
+        t = _tiles.auto_tile(p.n_buckets)
+        if t:
+            widths = sorted({b - a for a, b
+                             in _tiles.plan_tiles(p.n_buckets, t).tiles})
+            for w in widths:
+                th = enc_at(w)
+                specs.append(ProgramSpec(
+                    f"fused:enc@{w}", "enc", "fused",
+                    "DataCollectionTile", th, lambda: True,
+                    lambda th=th: th("call")))
+    return specs
 
 
 def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
@@ -548,7 +647,7 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     specs: dict[str, ProgramSpec] = {}
     for op, args_fn, batches, phase, gate in (
             _B_SCHEMAS + _shard_schemas(profile)
-            + _queue_schemas(profile)):
+            + _queue_schemas(profile) + _bucket_schemas(profile)):
         w = B.BUCKETED_OPS.get(op)
         for bexpr in batches:
             batch = int(bexpr(profile))

@@ -6,10 +6,14 @@ fixed-shape vectorized reduction producing an int64 statistics vector whose
 length depends only on the query (never the data), so the whole DP-side
 pipeline (encode -> encrypt -> aggregate) is one jittable program.
 """
+from . import tiles  # noqa: F401
 from .stats import (  # noqa: F401
+    GRID_OPS,
     OPS,
     DecryptedVector,
     decode,
     encode_clear,
+    encode_clear_tiled,
+    encode_clear_tiles,
     output_size,
 )

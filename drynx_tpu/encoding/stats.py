@@ -36,6 +36,25 @@ from fractions import Fraction
 import jax.numpy as jnp
 import numpy as np
 
+from . import tiles
+
+# The five ops that encode over the dense [query_min, query_max] value
+# grid — the reference's 1k..1M bucket scale axis (TIFS/maxOpti.py).
+GRID_OPS = ("min", "max", "frequency_count", "union", "inter")
+
+
+def grid_buckets(query) -> int:
+    """Bucket-grid width of a grid-op query (0 for every other op): the
+    ``compilecache.Profile.n_buckets`` axis that adds the bucket-tile
+    program set (registry._bucket_schemas) so tiled encode/encrypt/prove
+    dispatches hit the warm fast lane. Pure function of the query so
+    admission control and the cluster warmup derive the same axis; a
+    query without an operation (minimal shape stubs) is non-grid."""
+    op = getattr(query, "operation", None)
+    if op is None or op.name not in GRID_OPS:
+        return 0
+    return int(op.query_max) - int(op.query_min) + 1
+
 
 @dataclasses.dataclass
 class DecryptedVector:
@@ -85,12 +104,68 @@ def _presence(xs: jnp.ndarray, lo: int, hi: int) -> jnp.ndarray:
     return jnp.any(xs[:, None] == grid[None, :], axis=0).astype(jnp.int64)
 
 
+def encode_clear_tiles(op: str, data, query_min: int = 0, query_max: int = 0,
+                       tile: int | None = None, bit_scale=None):
+    """Per-tile grid encodings for the GRID_OPS: yields (offset, enc_tile)
+    pairs covering [query_min, query_max] in plan_tiles order.
+
+    Each tile's dispatch materializes at most an O(rows x tile) equality
+    mask (union / inter / frequency_count) or an O(tile) comparison grid
+    (min / max) — never the monolithic O(rows x buckets) mask. The
+    concatenation of the tiles is bit-identical to `encode_clear`: every
+    grid column's encoding depends only on that column's value and the
+    (once-reduced) local min/max, so tiling is pure slicing."""
+    if op not in GRID_OPS:
+        raise ValueError(f"not a grid op: {op!r}")
+    x = jnp.asarray(data, dtype=jnp.int64)
+    s = jnp.int64(1) if bit_scale is None else jnp.asarray(bit_scale, jnp.int64)
+    plan = tiles.plan_tiles(query_max - query_min + 1, tile)
+    # the O(rows) reduction happens ONCE, outside the tile loop
+    local = (jnp.min(x) if op == "min"
+             else jnp.max(x) if op == "max" else None)
+    for a, b in plan.tiles:
+        grid = jnp.arange(query_min + a, query_min + b, dtype=jnp.int64)
+        if op == "min":
+            enc = (grid >= local).astype(jnp.int64) * s
+        elif op == "max":
+            enc = (1 - (grid >= local).astype(jnp.int64)) * s
+        elif op == "frequency_count":
+            enc = jnp.sum(x[:, None] == grid[None, :],
+                          axis=0).astype(jnp.int64)
+        elif op == "union":
+            enc = jnp.any(x[:, None] == grid[None, :],
+                          axis=0).astype(jnp.int64) * s
+        else:  # inter
+            enc = (1 - jnp.any(x[:, None] == grid[None, :],
+                               axis=0).astype(jnp.int64)) * s
+        yield a, enc
+
+
+def encode_clear_tiled(op: str, data, query_min: int = 0, query_max: int = 0,
+                       tile: int | None = None, bit_scale=None):
+    """Tiled grid-op encoding, concatenated: bit-identical to
+    `encode_clear` with peak mask memory bounded by the tile
+    (TilePlan.peak_mask_elems). Tiles are pulled to host as they finish
+    so no more than one tile's mask is live at a time."""
+    parts = [np.asarray(enc) for _, enc in encode_clear_tiles(
+        op, data, query_min, query_max, tile, bit_scale)]
+    return jnp.asarray(np.concatenate(parts))
+
+
 def encode_clear(op: str, data, query_min: int = 0, query_max: int = 0,
                  preds=None, bit_scale=None):
     """Local sufficient statistics for one DP. `data`: int64 (rows,) or
     (rows, cols) for cosim (2 cols) / lin_reg (d features + label last).
     `preds`: model predictions for r2. `bit_scale`: optional random nonzero
-    int64 multiplier for OR/AND-family encodings (non-proof mode)."""
+    int64 multiplier for OR/AND-family encodings (non-proof mode).
+
+    Grid ops above tiles.TILE_THRESHOLD buckets encode through the
+    bucket-tile path by default (bit-identical; bounded peak memory)."""
+    if op in GRID_OPS:
+        t = tiles.auto_tile(query_max - query_min + 1)
+        if t:
+            return encode_clear_tiled(op, data, query_min, query_max, t,
+                                      bit_scale)
     x = jnp.asarray(data, dtype=jnp.int64)
     s = jnp.int64(1) if bit_scale is None else jnp.asarray(bit_scale, jnp.int64)
 
@@ -369,5 +444,7 @@ def _decode_linreg(v: np.ndarray, d: int):
 OPS = ["sum", "mean", "variance", "cosim", "bool_OR", "bool_AND", "min",
        "max", "frequency_count", "union", "inter", "lin_reg", "r2"]
 
-__all__ = ["OPS", "DecryptedVector", "encode_clear", "decode", "output_size",
-           "group_grid", "encode_clear_grouped", "decode_grouped"]
+__all__ = ["OPS", "GRID_OPS", "grid_buckets", "DecryptedVector",
+           "encode_clear", "decode",
+           "output_size", "group_grid", "encode_clear_grouped",
+           "decode_grouped", "encode_clear_tiles", "encode_clear_tiled"]

@@ -16,6 +16,21 @@ TPU-first shuffle: each server applies a secret permutation (device PRNG) and
 re-randomizes every ciphertext by adding a fresh encryption of zero — the
 composition over servers is the reference's Neff-shuffle pipeline's effect.
 The shuffle proof itself lives in drynx_tpu.proofs.
+
+Scale (reference TIFS/diffPri.py: noise lists 10k -> 1M, 81.9 -> 5872 s):
+above CHUNK elements the precompute and the shuffle re-randomization run in
+fixed-size slabs dispatched over the proof plane's `dp`-axis devices
+(parallel/proof_plane.dispatch_shards) instead of one (S, 2, 3, 16)
+dispatch. The global permutation stays exact — indices are permuted on the
+host and each slab gathers its slice — and every chunked output is
+byte-identical to the unchunked path for the same key (all the per-element
+crypto is element-wise; tests/test_scale_axes.py asserts it).
+
+API convention: `FixedBase` objects stop at the encryption boundary
+(encrypt_noise, dro_pipeline); the shuffle/precompute layer takes raw
+(64, 16, 3, 16) limb tables (`FixedBase.table`) and asserts it was not
+handed the wrapper — the two used to be silently interchangeable here,
+which hid a real type error in dro_pipeline.
 """
 from __future__ import annotations
 
@@ -27,6 +42,24 @@ import numpy as np
 
 from ..crypto import elgamal as eg
 
+# Slab width for chunked precompute / shuffle re-randomization: matches
+# the g1 family's max_bucket (crypto/batching.py) and the bucket-grid
+# tile (encoding/tiles.py), so slab dispatches land on the same warm
+# program sizes.
+CHUNK = 4096
+
+
+def _noise_reps(vs: np.ndarray, mean: float, b: float, quanta: float,
+                size: int) -> np.ndarray:
+    """Vectorized per-value repetition counts of the density grid.
+
+    np.round matches Python round() (both half-to-even) and np.exp is the
+    same libm exp() the scalar loop called — the golden test
+    (tests/test_scale_axes.py) pins equality with the reference loop."""
+    dens = np.exp(-np.abs(vs - mean) / b)
+    return np.maximum(
+        1, np.round(dens * size * quanta / (2.0 * b)).astype(np.int64))
+
 
 def generate_noise_values(size: int, mean: float, b: float, quanta: float,
                           scale: float = 1.0, limit: float = 0.0) -> np.ndarray:
@@ -36,7 +69,50 @@ def generate_noise_values(size: int, mean: float, b: float, quanta: float,
     services/service.go:657: values v = mean ± k*quanta, each repeated
     proportionally to exp(-|v-mean|/b); `scale` multiplies values before
     int64 quantization; `limit` (if nonzero) truncates |v| <= limit.
-    """
+
+    Vectorized as a NumPy density grid: the interpreted while/extend
+    accumulation was O(size) list growth — at the reference's 1M sizes it
+    dominated the phase. Output is exactly `_generate_noise_values_ref`'s
+    (golden-tested)."""
+    if size <= 0:
+        return np.zeros((0,), dtype=np.int64)
+    total = 0
+    vals = np.zeros((0,), dtype=np.float64)
+    k_lo, k_hi = 0, 0
+    grow = max(64, int(math.isqrt(size)))
+    while total < size and k_lo <= 10 * size:
+        k_hi = min(k_lo + grow, 10 * size + 1)
+        ks = np.arange(k_lo, k_hi, dtype=np.int64)
+        # candidate order within the loop: [m] then (m+kq, m-kq) pairs
+        vs = np.empty(2 * ks.size, dtype=np.float64)
+        vs[0::2] = mean + ks * quanta
+        vs[1::2] = mean - ks * quanta
+        if k_lo == 0:
+            vs = np.concatenate([vs[:1], vs[2:]])  # k=0 contributes once
+        if limit:
+            vs = vs[np.abs(vs) <= limit]
+        if vs.size:
+            reps = _noise_reps(vs, mean, b, quanta, size)
+            cum = np.cumsum(reps)
+            cut = int(np.searchsorted(cum, size - total))
+            if cut < vs.size:  # target reached inside this block
+                vals = np.concatenate(
+                    [vals, np.repeat(vs[:cut + 1], reps[:cut + 1])])
+                total += int(cum[cut])
+                break
+            vals = np.concatenate([vals, np.repeat(vs, reps)])
+            total += int(cum[-1])
+        k_lo = k_hi
+        grow *= 2
+    out = vals[:size] * scale
+    return np.round(out).astype(np.int64)
+
+
+def _generate_noise_values_ref(size: int, mean: float, b: float,
+                               quanta: float, scale: float = 1.0,
+                               limit: float = 0.0) -> np.ndarray:
+    """The original interpreted accumulation, kept verbatim as the golden
+    reference for the vectorized construction (unit-test only)."""
     if size <= 0:
         return np.zeros((0,), dtype=np.int64)
     vals: list[float] = []
@@ -57,13 +133,58 @@ def generate_noise_values(size: int, mean: float, b: float, quanta: float,
     return np.round(out).astype(np.int64)
 
 
+def _require_table(tbl, who: str):
+    """The shuffle/precompute layer's convention: raw limb tables only."""
+    if isinstance(tbl, eg.FixedBase):
+        raise TypeError(
+            f"{who} takes a raw fixed-base table (FixedBase.table), got a "
+            f"FixedBase wrapper — unwrap it at the encryption boundary")
+    return tbl
+
+
 def encrypt_noise(key, pub_table: eg.FixedBase, noise: np.ndarray):
     """Encrypt the noise list under the collective key."""
+    if not isinstance(pub_table, eg.FixedBase):
+        raise TypeError("encrypt_noise takes the FixedBase wrapper "
+                        "(the encryption boundary); got a raw table")
     ct, _ = eg.encrypt_ints(key, pub_table, jnp.asarray(noise))
     return ct
 
 
-def precompute_rerandomization(key, pub_tbl, size: int, base_tbl=None):
+def _chunk_of(size: int, chunk) -> int:
+    """Effective slab width: None = auto (CHUNK above CHUNK elements),
+    0 = force unchunked, positive = forced width."""
+    if chunk is None:
+        return CHUNK if size > CHUNK else 0
+    return int(chunk)
+
+
+def _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk: int, phase: str):
+    """Fresh zero-encryptions for blinding scalars r, in `chunk`-wide slabs
+    dispatched over the proof plane (element-wise: slab concatenation is
+    byte-identical to one full dispatch)."""
+    from . import proof_plane as plane
+
+    size = int(r.shape[0])
+    eff = _chunk_of(size, chunk)
+    if not eff or eff >= size:
+        zeros = jnp.zeros((size,), dtype=jnp.int64)
+        return eg.encrypt_with_tables(base_tbl, pub_tbl,
+                                      eg.int_to_scalar(zeros), r)
+
+    def slab(i, a, b):
+        rs = plane.put_shard(r[a:b], i)
+        zeros = jnp.zeros((b - a,), dtype=jnp.int64)
+        return eg.encrypt_with_tables(base_tbl, pub_tbl,
+                                      eg.int_to_scalar(zeros), rs)
+
+    slabs = [(a, min(a + eff, size)) for a in range(0, size, eff)]
+    parts = plane.dispatch_shards(phase, slab, slabs)
+    return jnp.concatenate(parts, axis=0)
+
+
+def precompute_rerandomization(key, pub_tbl, size: int, base_tbl=None,
+                               chunk: int | None = None):
     """Precompute the expensive half of a shuffle step: `size` fresh
     encryptions of zero (r·B, r·P) plus their scalars.
 
@@ -72,12 +193,16 @@ def precompute_rerandomization(key, pub_tbl, size: int, base_tbl=None):
     unlynx PrecomputationWritingForShuffling) — it is what makes the
     1M-element DRO noise lists survivable. Returns (zero_cts, r) usable as
     the `precomp` argument of shuffle_rerandomize.
-    """
+
+    Above CHUNK elements the fixed-base mults run in `chunk`-wide slabs
+    over the proof-plane devices (byte-identical to one dispatch; the
+    scalars r are always drawn in ONE call so chunking never changes
+    them). chunk: None = auto, 0 = force monolithic."""
+    _require_table(pub_tbl, "precompute_rerandomization")
     base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
     r = eg.random_scalars(key, (size,))
-    zeros = jnp.zeros((size,), dtype=jnp.int64)
-    zero_ct = eg.encrypt_with_tables(base_tbl, pub_tbl,
-                                     eg.int_to_scalar(zeros), r)
+    zero_ct = _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk,
+                                     "DROPrecompute")
     return zero_ct, r
 
 
@@ -92,44 +217,74 @@ def load_precompute(path: str):
     return jnp.asarray(d["zero_ct"]), jnp.asarray(d["r"])
 
 
-def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None, precomp=None):
+def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None, precomp=None,
+                        chunk: int | None = None):
     """One server's DRO step: secret permutation + re-randomization.
 
     cts: (S, 2, 3, 16). Returns (shuffled cts, permutation, rerand scalars)
     — the latter two feed the shuffle proof. `precomp` (from
     precompute_rerandomization) skips the S fixed-base scalar-mults — the
     hot cost at reference noise sizes (10k..1M, TIFS/diffPri.py).
-    """
-    S = cts.shape[0]
+
+    chunk (None = auto above CHUNK, 0 = force monolithic): permute the
+    indices on the host, then gather + re-randomize in `chunk`-wide slabs
+    over the proof-plane devices instead of one (S, 2, 3, 16) dispatch.
+    The permutation and blinding scalars are drawn identically either way
+    and ct_add is element-wise, so chunked output is byte-identical to
+    unchunked for the same key."""
+    _require_table(pub_tbl, "shuffle_rerandomize")
+    S = int(cts.shape[0])
     kperm, krand = jax.random.split(key)
     perm = jax.random.permutation(kperm, S)
-    shuffled = jnp.take(cts, perm, axis=0)
     if precomp is not None:
         zero_ct, r = precomp
         assert zero_ct.shape[0] == S, (zero_ct.shape, S)
     else:
         base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
         r = eg.random_scalars(krand, (S,))
-        zeros = jnp.zeros((S,), dtype=jnp.int64)
-        zero_ct = eg.encrypt_with_tables(base_tbl, pub_tbl,
-                                         eg.int_to_scalar(zeros), r)
-    return eg.ct_add(shuffled, zero_ct), perm, r
+        zero_ct = _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk,
+                                         "DRORerand")
+
+    eff = _chunk_of(S, chunk)
+    if not eff or eff >= S:
+        shuffled = jnp.take(cts, perm, axis=0)
+        return eg.ct_add(shuffled, zero_ct), perm, r
+
+    from . import proof_plane as plane
+
+    perm_h = np.asarray(perm)
+
+    def slab(i, a, b):
+        # exact global permutation: host-permuted indices, per-slab gather
+        gathered, zc = plane.put_shard(
+            (jnp.take(cts, jnp.asarray(perm_h[a:b]), axis=0),
+             zero_ct[a:b]), i)
+        return eg.ct_add(gathered, zc)
+
+    slabs = [(a, min(a + eff, S)) for a in range(0, S, eff)]
+    parts = plane.dispatch_shards("DROShuffle", slab, slabs)
+    return jnp.concatenate(parts, axis=0), perm, r
 
 
-def dro_pipeline(key, pub_tbl, size: int, mean: float, b: float,
-                 quanta: float, scale: float = 1.0, limit: float = 0.0,
-                 n_servers: int = 3):
+def dro_pipeline(key, pub_tbl: eg.FixedBase, size: int, mean: float,
+                 b: float, quanta: float, scale: float = 1.0,
+                 limit: float = 0.0, n_servers: int = 3,
+                 chunk: int | None = None):
     """Full noise phase: generate, encrypt, pass through every server's
     shuffle+rerandomize. Returns the final encrypted noise list."""
+    if not isinstance(pub_tbl, eg.FixedBase):
+        raise TypeError("dro_pipeline takes the FixedBase wrapper; pass "
+                        "pub_tbl.table only to the shuffle layer")
     noise = generate_noise_values(size, mean, b, quanta, scale, limit)
     key, sub = jax.random.split(key)
     cts = encrypt_noise(sub, pub_tbl, noise)
     for _ in range(n_servers):
         key, sub = jax.random.split(key)
-        cts, _, _ = shuffle_rerandomize(sub, cts, pub_tbl.table)
+        cts, _, _ = shuffle_rerandomize(sub, cts, pub_tbl.table,
+                                        chunk=chunk)
     return cts, noise
 
 
 __all__ = ["generate_noise_values", "encrypt_noise", "shuffle_rerandomize",
            "precompute_rerandomization", "save_precompute", "load_precompute",
-           "dro_pipeline"]
+           "dro_pipeline", "CHUNK"]

@@ -49,6 +49,11 @@ def main(argv=None) -> int:
                     help="cross-survey batch width (drynx_tpu/server); "
                          ">1 adds the cross-survey verify program set at "
                          "queue-concatenated batch sizes")
+    ap.add_argument("--buckets", type=int, default=0,
+                    help="bucket-grid width of a grid-op survey (min/max/"
+                         "frequency_count/union/inter); above the tile "
+                         "threshold adds the bucket-tile program set at "
+                         "tile-derived shard sizes")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -69,7 +74,8 @@ def main(argv=None) -> int:
     profile = cc.Profile(n_cns=args.n_cns, n_dps=args.n_dps,
                          n_values=args.values, u=args.range_u,
                          l=args.range_l, dlog_limit=args.dlog_limit,
-                         n_shards=n_shards, n_queue=max(1, args.queue))
+                         n_shards=n_shards, n_queue=max(1, args.queue),
+                         n_buckets=max(0, args.buckets))
 
     if args.list:
         specs = cc.build_registry(profile)

@@ -689,7 +689,8 @@ def _response_kernel(digits, c, rs, s, t, m_tot, v):
 def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
                         u: int, l: int, ca_pub_table,
                         use_gt_table: bool = True,
-                        shard: bool | None = None) -> RangeProofBatch:
+                        shard: bool | None = None,
+                        tile: int | None = None) -> RangeProofBatch:
     """Create proofs for V values at once.
 
     secrets: int64 (V,) plaintexts; rs: (V, 16) encryption blinding scalars;
@@ -705,7 +706,19 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     the value (`dp`) axis; None = shard iff the plane is enabled
     (parallel/proof_plane.py — the default on a >= 2-device mesh).
     Transcripts are bit-identical either way.
+
+    tile: cap every commit-stage dispatch at `tile` values — the
+    bucket-tile path for grid-encoded surveys (encoding/tiles.py), where
+    V reaches the reference's 1k..1M bucket axis and a single dispatch
+    would materialize the whole (ns, V, l, 6, 2, 16) GT tensor at once.
+    None = auto (tiles above tiles.TILE_THRESHOLD, the default at
+    scale); 0 = never tile. The per-value randomness is drawn in the
+    SAME four full-size calls either way and the Fiat-Shamir challenge
+    is hashed per value from the gathered commitments, so the tiled
+    transcripts are byte-identical to the monolithic path.
     """
+    from ..encoding import tiles as _tiles
+
     V = int(np.asarray(secrets).shape[0])
     ns = len(sigs)
     digits = jnp.asarray(to_base(np.asarray(secrets), u, l), dtype=jnp.int32)  # (V, l)
@@ -727,14 +740,24 @@ def create_range_proofs(key, secrets, rs, cts, sigs: list[RangeSig],
     # commit -> Fiat-Shamir (binds D, V_pts, a) -> respond. The canonical
     # commitment bytes are computed ONCE here and cached on the batch: they
     # are both the hash input and the wire format (to_bytes reuses them).
-    if shard is None:
-        from ..parallel import proof_plane as plane
+    from ..parallel import proof_plane as plane
 
+    if shard is None:
         shard = plane.enabled()
-    commit_fn = _commit_kernel_sharded if shard else _commit_kernel
-    D, m_tot, V_pts, a = commit_fn(
-        digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA,
-        gtA_pow=gtA_pow)
+    if tile is None:
+        tile = _tiles.auto_tile(V)
+    # shard count = max(plane policy, tile chunking): each per-tile
+    # dispatch is bounded by the tile AND lands on a plane device
+    n_shards = max(plane.n_shards() if shard else 1,
+                   _tiles.proof_tile_shards(V, tile) if tile else 1)
+    if n_shards > 1:
+        D, m_tot, V_pts, a = _commit_kernel_sharded(
+            digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA,
+            gtA_pow=gtA_pow, n_shards=n_shards)
+    else:
+        D, m_tot, V_pts, a = _commit_kernel(
+            digits, s, t, m, v, A_tab, ca_pub_table, u, l, gtA=gtA,
+            gtA_pow=gtA_pow)
     wire = _range_wire_dict(cts, D, V_pts, a)
     c = jnp.asarray(challenge_from_wire(wire, sum_publics_bytes(sigs), u, l), dtype=jnp.uint32)
     zphi, zr, zv = _response_kernel(digits, c, jnp.asarray(rs, dtype=jnp.uint32), s, t,
@@ -1017,10 +1040,13 @@ def group_ranges(ranges) -> dict:
 
 
 def create_range_proof_list(key, secrets, rs, cts, ranges,
-                            sigs_by_u: dict, ca_pub_table) -> RangeProofList:
+                            sigs_by_u: dict, ca_pub_table,
+                            tile: int | None = None) -> RangeProofList:
     """Create the per-DP mixed-range payload.
 
     ranges: [(u, l)] per output index; sigs_by_u: {u: [RangeSig per CN]}.
+    tile: forwarded to create_range_proofs (None = auto bucket-tiling
+    above the threshold — the grid-op scale path).
     """
     secrets = np.asarray(secrets)
     batches = []
@@ -1029,7 +1055,7 @@ def create_range_proof_list(key, secrets, rs, cts, ranges,
         ia = np.asarray(idx, dtype=np.int64)
         pb = create_range_proofs(
             sub, secrets[ia], jnp.asarray(rs, dtype=jnp.uint32)[ia], jnp.asarray(cts, dtype=jnp.uint32)[ia],
-            sigs_by_u[u], u, l, ca_pub_table)
+            sigs_by_u[u], u, l, ca_pub_table, tile=tile)
         batches.append((ia, pb))
     return RangeProofList(n_values=len(ranges), batches=batches)
 
@@ -1053,7 +1079,8 @@ def _slice_batch(pb: RangeProofBatch, sel: np.ndarray) -> RangeProofBatch:
 
 def create_range_proof_lists_batched(key, secrets_2d, rs_2d, cts_2d, ranges,
                                      sigs_by_u: dict,
-                                     ca_pub_table) -> list:
+                                     ca_pub_table,
+                                     tile: int | None = None) -> list:
     """All DPs' payloads in ONE device-batched creation (the single-chip
     harness path: n_dps DPs share the chip, so their per-value-independent
     proofs vectorize into one kernel chain instead of n_dps serialized
@@ -1071,7 +1098,7 @@ def create_range_proof_lists_batched(key, secrets_2d, rs_2d, cts_2d, ranges,
     big = create_range_proof_list(
         key, secrets_2d.reshape(-1), jnp.asarray(rs_2d, dtype=jnp.uint32).reshape(-1, 16),
         jnp.asarray(cts_2d, dtype=jnp.uint32).reshape(-1, 2, 3, 16), flat_ranges, sigs_by_u,
-        ca_pub_table)
+        ca_pub_table, tile=tile)
     out = []
     for d in range(n_dps):
         batches = []

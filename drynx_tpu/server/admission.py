@@ -20,6 +20,7 @@ import dataclasses
 import threading
 
 from .. import compilecache as cc
+from ..encoding import stats as st
 from ..parallel import proof_plane as plane
 
 
@@ -73,7 +74,8 @@ class AdmissionController:
             n_dps=len(self.cluster.dp_idents),
             n_values=max(len(ranges), 1), u=int(u0) or 16,
             l=int(l0) or 5, dlog_limit=self.cluster.dlog.limit,
-            n_shards=plane.n_shards(), n_queue=self.n_queue)
+            n_shards=plane.n_shards(), n_queue=self.n_queue,
+            n_buckets=st.grid_buckets(q))
 
     @staticmethod
     def needed(profile: cc.Profile) -> set[str]:

@@ -33,6 +33,7 @@ from ..crypto import curve as C
 from ..crypto import elgamal as eg
 from ..crypto import refimpl
 from ..encoding import stats as st
+from ..encoding import tiles as enc_tiles
 from ..models import logreg as lr
 from ..parallel import collective as col
 from ..parallel import dro
@@ -465,6 +466,8 @@ class LocalCluster:
 
         return enc, _fused_agg, ks, _fused_dec
 
+    # bucket-grid Profile axis: st.grid_buckets(q) — shared with admission
+
     @staticmethod
     def _ranges_per_value(q) -> list:
         """Per-OUTPUT-INDEX (u, l) specs: the query's per-V ranges, tiled
@@ -504,7 +507,8 @@ class LocalCluster:
             n_cns=len(self.cns), n_dps=len(self.dp_idents),
             n_values=max(len(ranges), 1), u=int(u0) or 16,
             l=int(l0) or 5, dlog_limit=self.dlog.limit,
-            n_shards=plane.n_shards())
+            n_shards=plane.n_shards(),
+            n_buckets=st.grid_buckets(q))
         with self._proof_device_lock:
             cc.trace_guard()
             before = cc.STATS.totals()
@@ -627,7 +631,21 @@ class LocalCluster:
         key, k_enc = jax.random.split(key)
         enc_rs = eg.random_scalars(k_enc, dp_stats.shape)
         f_enc, f_agg, f_ks, f_dec = self._fused()
-        cts = f_enc(jnp.asarray(dp_stats), enc_rs)          # (n_dps, V, 2,3,16)
+        enc_tile = enc_tiles.auto_tile(V)
+        if enc_tile:
+            # bucket-tiled encryption (grid-op scale axis): the fused enc
+            # program runs per value-axis slab so no single dispatch
+            # materializes the full (n_dps, V, 2, 3, 16) ciphertext array
+            # (384 MB at 1M buckets). enc_rs is drawn full-size above and
+            # sliced, and the program is element-wise per (dp, value), so
+            # the concatenation is bit-identical to one dispatch. Balanced
+            # tiles -> at most two slab shapes compile.
+            stats_dev = jnp.asarray(dp_stats)
+            parts = [np.asarray(f_enc(stats_dev[:, a:b], enc_rs[:, a:b]))
+                     for a, b in enc_tiles.plan_tiles(V, enc_tile).tiles]
+            cts = jnp.asarray(np.concatenate(parts, axis=1))
+        else:
+            cts = f_enc(jnp.asarray(dp_stats), enc_rs)      # (n_dps, V, 2,3,16)
         cts.block_until_ready()
         if self.link.active:
             # DP->CN uploads ride INDEPENDENT links in parallel (the

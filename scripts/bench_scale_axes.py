@@ -1,0 +1,413 @@
+"""Reference-scale axes bench: bucket grids, rows/DP, and DRO noise sizes
+measured point-by-point under the jax-free supervisor pattern (bench.py).
+
+The reference evaluates three independent scale axes the flagship bench
+holds fixed: min/max bucket ranges 1k -> 1M (maxOpti.py), dataset rows
+per DP 600 -> 600k (serversEval.py), and DRO noise-list sizes 10k -> 1M
+(diffPri.py). This harness walks each grid with ONE CHILD PROCESS PER
+POINT so a segfault, OOM kill, or timeout at 1M buckets is a labeled
+record for that point instead of a dead bench:
+
+  * the parent never imports jax — it only spawns children, enforces a
+    per-point timeout, labels the outcome (ok / rc:<n> / signal:<NAME> /
+    timeout), prints ONE JSON LINE PER POINT, and maintains the
+    progressive record file (BENCH_SCALE_r01.json, atomic replace);
+  * each `--measure-child` runs exactly one (axis, n) point with phase
+    timers (cold = first dispatch including compile, warm = repeat) and
+    writes a progressive record so even a killed child leaves its last
+    completed stage behind.
+
+CPU runs capture bounded prefixes for the crypto phases: encrypt /
+precompute / shuffle are measured over one tile- or chunk-sized slab and
+projected linearly, with the measured basis recorded on the point
+(`*_basis_n`, `*_projected_s`) — never silently truncated. The pure-host
+phases (tiled encode, vectorized noise generation) always run at full n.
+
+Usage:
+  python scripts/bench_scale_axes.py --cpu            # full CPU grid
+  python scripts/bench_scale_axes.py --cpu --smoke    # check.sh tier,
+                                                      # tiny grids, <1 min
+  python scripts/bench_scale_axes.py --cpu --axes minmax,dro
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402  (jax-free supervisor helpers)
+
+RECORD = os.path.join(ROOT, "BENCH_SCALE_r01.json")
+CHILD_TIMEOUT_S = float(os.environ.get("DRYNX_SCALE_CHILD_TIMEOUT_S", 900))
+
+# The three reference axes. minmax: bucket range R of a min/max survey
+# (maxOpti.py 1k..1M); rows: dataset rows per DP (serversEval.py 600..600k)
+# against a fixed 1024-bucket frequency grid; dro: noise-list size
+# (diffPri.py 10k..1M).
+GRIDS = {
+    "minmax": [1024, 4096, 16384, 65536, 262144, 1048576],
+    "rows": [600, 8192, 65536, 600000],
+    "dro": [10000, 100000, 1000000],
+}
+# check.sh `scale` tier: tiny everything, pure-host + one small crypto
+# dispatch, budget < 1 min total on the 1-core CPU box.
+SMOKE_GRIDS = {
+    "minmax": [256],
+    "rows": [1024],
+    "dro": [512],
+}
+
+MINMAX_ROWS = 600        # rows per DP on the minmax axis (reference fixed)
+ROWS_GRID = 1024         # frequency grid width on the rows axis
+ENC_SLAB = 4096          # encrypt measured-prefix width (one tile slab)
+DRO_MEAS_CAP = 4096      # DRO crypto measured-prefix cap on CPU
+PROVE_BASIS = 128        # range-proof create/verify basis (values)
+
+
+def log(msg):
+    print(f"[scale] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent (jax-free)
+# ---------------------------------------------------------------------------
+
+def point_result(axis, n, outcome, rc, elapsed_s, record):
+    """One point's labeled record from a supervised child outcome and its
+    last progressive record (pure — unit-tested with stub children in
+    tests/test_scale_axes.py, mirroring bench.supervisor_result)."""
+    rec = dict(record or {})
+    stage = rec.pop("stage", None)
+    base = {"axis": axis, "n": int(n), "outcome": outcome, "rc": rc,
+            "elapsed_s": round(elapsed_s, 1)}
+    if outcome == "ok" and stage == "complete":
+        base["status"] = "ok"
+        base.update(rec)
+        return base
+    if outcome == "ok":
+        base["status"] = "child_exited_without_record"
+    elif outcome == "timeout":
+        base["status"] = "timeout"
+    elif outcome.startswith("signal:"):
+        base["status"] = "killed_" + outcome.split(":", 1)[1].lower()
+    else:
+        base["status"] = "failed_" + outcome.replace(":", "")
+    base["last_stage"] = stage or "none"
+    base.update(rec)
+    return base
+
+
+def skip_result(axis, n, reason):
+    """A planned point NOT run — recorded, never silently dropped."""
+    return {"axis": axis, "n": int(n), "status": "skipped",
+            "reason": reason}
+
+
+def write_progressive(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _arm_parent():
+    def _bye(signum, frame):
+        child = bench._CURRENT_CHILD
+        if child is not None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _bye)
+    signal.signal(signal.SIGINT, _bye)
+
+
+def main_parent(args):
+    _arm_parent()
+    grids = SMOKE_GRIDS if args.smoke else GRIDS
+    axes = [a.strip() for a in args.axes.split(",")] if args.axes \
+        else list(grids)
+    for a in axes:
+        if a not in grids:
+            raise SystemExit(f"unknown axis {a!r} (have {list(grids)})")
+
+    timeout = args.timeout or (120 if args.smoke else CHILD_TIMEOUT_S)
+    doc = {"round": "r08", "smoke": bool(args.smoke),
+           "backend": "cpu" if args.cpu else "default",
+           "child_timeout_s": timeout,
+           "grids": {a: grids[a] for a in axes}, "points": []}
+    out = args.out or RECORD
+    record_path = os.path.join(ROOT, ".scale_point_record.json")
+
+    for axis in axes:
+        for n in grids[axis]:
+            try:
+                os.remove(record_path)
+            except OSError:
+                pass
+            env = dict(os.environ)
+            if args.cpu:
+                env["JAX_PLATFORMS"] = "cpu"
+                # AVX2 only — NOT xla_backend_optimization_level=0: that
+                # trades ~15x slower kernel execution for faster compiles,
+                # and these grids are execution-dominated (the cold/warm
+                # split already attributes compile time per phase)
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_cpu_max_isa" not in flags:
+                    flags += " --xla_cpu_max_isa=AVX2"
+                env["XLA_FLAGS"] = flags.strip()
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--measure-child", "--axis", axis, "--point", str(n),
+                   "--record-path", record_path]
+            if args.smoke:
+                cmd.append("--smoke")
+            if args.cpu:
+                cmd.append("--cpu")
+            log(f"{axis} n={n}: starting child (timeout {timeout:.0f}s)")
+            outcome, rc, elapsed, _out = bench.supervise_child(
+                cmd, timeout, env=env)
+            pt = point_result(axis, n, outcome, rc, elapsed,
+                              bench.read_record(record_path))
+            print(json.dumps(pt), flush=True)
+            doc["points"].append(pt)
+            if not args.smoke or args.out:
+                write_progressive(out, doc)
+    try:
+        os.remove(record_path)
+    except OSError:
+        pass
+    bad = [p for p in doc["points"]
+           if p.get("status") not in ("ok", "skipped")]
+    log(f"done: {len(doc['points'])} points, {len(bad)} not ok")
+    return 1 if bad else 0
+
+
+# ---------------------------------------------------------------------------
+# Child (one grid point; all jax work below)
+# ---------------------------------------------------------------------------
+
+_REC_PATH = None
+_REC = {}
+
+
+def wr(stage, **fields):
+    """Progressive per-point record (atomic replace, bench.py pattern)."""
+    _REC.update(fields)
+    _REC["stage"] = stage
+    if _REC_PATH is None:
+        return
+    tmp = _REC_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_REC, f)
+    os.replace(tmp, _REC_PATH)
+
+
+def _timed(fn):
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0, out
+
+
+def child_minmax(n, smoke):
+    """One min/max bucket-range point: tiled encode at full R, encrypt
+    over one tile slab (projected), range proofs at a fixed value basis
+    (projected) — the three phases whose cost carries the R axis."""
+    import numpy as np
+    import jax.numpy as jnp
+    import jax
+
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.encoding import stats as st
+    from drynx_tpu.encoding import tiles
+
+    R = n
+    t = tiles.auto_tile(R)
+    plan = tiles.plan_tiles(R, t) if t else None
+    wr("plan", tile=(plan.tile if plan else 0),
+       n_tiles=(plan.n_tiles if plan else 1),
+       peak_mask_elems=(plan.peak_mask_elems(MINMAX_ROWS) if plan
+                        else MINMAX_ROWS * R))
+
+    rng = np.random.default_rng(8)
+    data = jnp.asarray(rng.integers(0, R, MINMAX_ROWS), dtype=jnp.int64)
+    enc_cold, stats = _timed(
+        lambda: st.encode_clear("min", data, 0, R - 1))
+    enc_warm, _ = _timed(lambda: st.encode_clear("min", data, 0, R - 1))
+    wr("encode", encode_cold_s=round(enc_cold, 3),
+       encode_warm_s=round(enc_warm, 3), encode_n=R)
+
+    if smoke:
+        # the dro smoke child already exercises a crypto dispatch; this
+        # one stays pure-host so the check.sh tier fits its time budget
+        wr("complete", encrypt="skipped: smoke tier",
+           prove="skipped: smoke tier")
+        return
+
+    _, pub = eg.keygen(rng)
+    tbl = eg.pub_table(pub)
+    w = min(R, ENC_SLAB)
+    key = jax.random.PRNGKey(8)
+    e_cold, (cts, rs) = _timed(
+        lambda: eg.encrypt_ints(key, tbl, stats[:w]))
+    e_warm, _ = _timed(lambda: eg.encrypt_ints(key, tbl, stats[:w]))
+    wr("encrypt", encrypt_cold_s=round(e_cold, 3),
+       encrypt_warm_s=round(e_warm, 3), encrypt_basis_n=w,
+       encrypt_projected_s=round(e_warm * (R / w), 1))
+
+    from drynx_tpu.proofs import range_proof as rp
+
+    V = min(R, PROVE_BASIS)
+    sigs = [rp.init_range_sig(2, rng) for _ in range(2)]
+    kp = jax.random.PRNGKey(9)
+    t0 = time.perf_counter()
+    proof = rp.create_range_proofs(
+        kp, np.asarray(stats[:V], dtype=np.int64), rs[:V], cts[:V],
+        sigs, 2, 1, tbl.table)
+    jax.block_until_ready(proof.commit)
+    p_s = time.perf_counter() - t0
+    wr("prove", prove_s=round(p_s, 2), prove_basis_n=V,
+       prove_projected_s=round(p_s * (R / V), 1),
+       prove_includes_compile=True)
+    t0 = time.perf_counter()
+    ok = np.asarray(rp.verify_range_proofs(
+        proof, [s.public for s in sigs], tbl.table))
+    v_s = time.perf_counter() - t0
+    assert bool(np.all(ok)), "basis proofs failed to verify"
+    wr("complete", verify_s=round(v_s, 2), verify_basis_n=V,
+       verify_projected_s=round(v_s * (R / V), 1),
+       verify_includes_compile=True)
+
+
+def child_rows(n, smoke):
+    """One rows-per-DP point: the per-DP pipeline at fixed grid width —
+    O(rows x grid) frequency encode, DP noise-value generation, and the
+    grid-width encrypt (rows-independent, recorded for phase share)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import jax
+
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.encoding import stats as st
+    from drynx_tpu.parallel import dro
+
+    rows, G = n, ROWS_GRID
+    rng = np.random.default_rng(8)
+    data = jnp.asarray(rng.integers(0, G, rows), dtype=jnp.int64)
+    enc_cold, stats = _timed(
+        lambda: st.encode_clear("frequency_count", data, 0, G - 1))
+    enc_warm, _ = _timed(
+        lambda: st.encode_clear("frequency_count", data, 0, G - 1))
+    wr("encode", encode_cold_s=round(enc_cold, 3),
+       encode_warm_s=round(enc_warm, 3), encode_rows=rows, grid=G)
+
+    t0 = time.perf_counter()
+    noise = dro.generate_noise_values(rows, 0.0, 30.0, 100.0)
+    wr("noise", noise_s=round(time.perf_counter() - t0, 3),
+       noise_n=len(noise))
+
+    if smoke:
+        wr("complete", encrypt="skipped: smoke tier")
+        return
+    _, pub = eg.keygen(rng)
+    tbl = eg.pub_table(pub)
+    key = jax.random.PRNGKey(8)
+    e_cold, _ = _timed(lambda: eg.encrypt_ints(key, tbl, stats))
+    e_warm, _ = _timed(lambda: eg.encrypt_ints(key, tbl, stats))
+    wr("complete", encrypt_cold_s=round(e_cold, 3),
+       encrypt_warm_s=round(e_warm, 3), encrypt_n=G)
+
+
+def child_dro(n, smoke):
+    """One DRO noise-size point: vectorized noise generation at full n,
+    chunked zero-encryption precompute and the permute+rerandomize
+    shuffle over a measured prefix (projected, basis recorded)."""
+    import numpy as np
+    import jax
+
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.parallel import dro
+
+    t0 = time.perf_counter()
+    noise = dro.generate_noise_values(n, 0.0, 30.0, 100.0)
+    wr("noise", noise_s=round(time.perf_counter() - t0, 3),
+       noise_n=len(noise))
+
+    rng = np.random.default_rng(8)
+    _, pub = eg.keygen(rng)
+    tbl = eg.pub_table(pub)
+    m = n if smoke else min(n, DRO_MEAS_CAP)
+    key = jax.random.PRNGKey(8)
+    p_cold, precomp = _timed(
+        lambda: dro.precompute_rerandomization(key, tbl.table, m))
+    p_warm, precomp = _timed(
+        lambda: dro.precompute_rerandomization(key, tbl.table, m))
+    wr("precompute", precompute_cold_s=round(p_cold, 3),
+       precompute_warm_s=round(p_warm, 3), dro_basis_n=m,
+       precompute_projected_s=round(p_warm * (n / m), 1))
+
+    # the precomputed zero-encryptions double as the input pool: shuffle
+    # cost depends only on the element count, not the plaintexts
+    cts = precomp[0]
+    ks = jax.random.PRNGKey(9)
+    s_cold, _ = _timed(lambda: dro.shuffle_rerandomize(
+        ks, cts, tbl.table, precomp=precomp))
+    s_warm, _ = _timed(lambda: dro.shuffle_rerandomize(
+        ks, cts, tbl.table, precomp=precomp))
+    wr("complete", shuffle_cold_s=round(s_cold, 3),
+       shuffle_warm_s=round(s_warm, 3),
+       shuffle_projected_s=round(s_warm * (n / m), 1))
+
+
+def main_child(args):
+    global _REC_PATH
+    _REC_PATH = args.record_path
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, file=sys.stderr)
+    faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    wr("start", smoke=bool(args.smoke))
+    {"minmax": child_minmax, "rows": child_rows,
+     "dro": child_dro}[args.axis](args.point, args.smoke)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="scale-axes grid bench (one supervised child/point)")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny grids + no proof phase (check.sh tier)")
+    ap.add_argument("--axes", default=None,
+                    help="comma list of axes (default: all)")
+    ap.add_argument("--out", default=None,
+                    help=f"record path (default {RECORD})")
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("--measure-child", action="store_true")
+    ap.add_argument("--axis", default=None)
+    ap.add_argument("--point", type=int, default=None)
+    ap.add_argument("--record-path", default=None)
+    args = ap.parse_args(argv)
+
+    if args.measure_child:
+        if args.cpu:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return main_child(args)
+    return main_parent(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,9 @@ echo "== chaos quick tier (seeded fault injection, -m 'chaos and not slow') =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     -m 'chaos and not slow' tests/test_resilience.py
 
+echo "== scale smoke (tiny grid points, one supervised child per point) =="
+python scripts/bench_scale_axes.py --cpu --smoke > /dev/null
+
 echo "== server tier (standing scheduler quick tests + 3-survey demo) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not slow' \
     tests/test_server.py

@@ -45,13 +45,25 @@ if "xla_backend_optimization_level" not in _flags:
     _flags = (_flags + " --xla_backend_optimization_level=0").strip()
 os.environ["XLA_FLAGS"] = _flags
 
+# On-disk persistent compilation cache for the test suite. An earlier
+# jaxlib crashed deserializing large crypto executables
+# (compilation_cache.get_executable_and_time on a pairing kernel), so this
+# stayed off; re-validated on the current jaxlib with the ISA pinned to
+# AVX2 above (the pin makes cache entries stable across feature
+# detection), populate+reload of the heaviest compiled-GT-tier tests is
+# clean and roughly halves their wall time. The suite's XLA compile bill
+# is most of its 870 s tier-1 budget, so warm reruns need this to keep
+# headroom as the suite grows. DRYNX_TEST_JAX_CACHE=0 disables;
+# DRYNX_TEST_JAX_CACHE=<dir> relocates (default: .jax_cache_tests/ at the
+# repo root, gitignored).
+_cache = os.environ.get("DRYNX_TEST_JAX_CACHE", "")
+if _cache != "0":
+    if not _cache:
+        _cache = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache_tests")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-
-# NOTE: the on-disk persistent compilation cache is intentionally NOT enabled
-# here: jaxlib segfaults deserializing the very large crypto-kernel
-# executables (crash inside compilation_cache.get_executable_and_time when a
-# pairing kernel round-trips through the cache). Compile-time control comes
-# from small rolled field kernels + per-bucket jits (crypto/batching.py)
-# reused within the process instead.

@@ -92,6 +92,49 @@ def test_registry_sharded_program_set():
             assert int(s.name.rsplit("@", 1)[1]) <= 2048
 
 
+def test_registry_bucket_grid_program_set():
+    """Profile.n_buckets above the tile threshold must add the bucket-tile
+    programs (tile-derived creation shards + fused enc at tile slab
+    widths) and must only ever ADD: the plain registry at the same grid
+    shape is a strict subset, mirroring the n_shards / n_queue
+    contracts."""
+    grid = cc.Profile(n_values=65536, u=2, l=1, n_buckets=65536)
+    plain = cc.Profile(n_values=65536, u=2, l=1)
+    grid_names = {s.name for s in cc.build_registry(grid)}
+    plain_names = {s.name for s in cc.build_registry(plain)}
+    assert plain_names <= grid_names
+    extra = [s for s in cc.build_registry(grid)
+             if s.name not in plain_names]
+    assert extra, "n_buckets=65536 must add bucket-tile programs"
+    phases = {s.phase for s in extra}
+    assert phases <= {"RangeProofCreateTile", "DataCollectionTile"}
+    assert "RangeProofCreateTile" in phases
+    # the chunked-encrypt slab program at the tile width
+    assert any(s.name.startswith("fused:enc@") for s in extra)
+
+
+def test_registry_bucket_grid_below_threshold_is_identity():
+    """A grid at or below the tile threshold never tiles, so n_buckets
+    must add nothing — the existing program set is exactly preserved."""
+    with_b = cc.build_registry(
+        cc.Profile(n_values=256, u=2, l=1, n_buckets=256))
+    without = cc.build_registry(cc.Profile(n_values=256, u=2, l=1))
+    assert {s.name for s in with_b} == {s.name for s in without}
+
+
+def test_registry_n_buckets_zero_is_identity():
+    base = cc.BENCH
+    zero = cc.build_registry(dataclasses_replace(base, n_buckets=0))
+    assert {s.name for s in zero} == {s.name
+                                      for s in cc.build_registry(base)}
+
+
+def dataclasses_replace(p, **kw):
+    import dataclasses
+
+    return dataclasses.replace(p, **kw)
+
+
 def test_registry_n_shards_one_is_identity():
     base = cc.BENCH
     one = cc.build_registry(
@@ -159,3 +202,17 @@ def test_cli_list_shards_includes_shard_programs(capsys):
     assert cli.main(["--list", "--shards", "1"]) == 0
     out = capsys.readouterr().out
     assert "RangeProofVerifyShard" not in out
+
+
+def test_cli_list_buckets_includes_tile_programs(capsys):
+    from drynx_tpu import precompile as cli
+
+    assert cli.main(["--list", "--buckets", "65536", "--values", "65536",
+                     "--range-u", "2", "--range-l", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "RangeProofCreateTile" in out
+    assert "fused:enc@" in out
+    # no grid axis -> no tile programs
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "RangeProofCreateTile" not in out
