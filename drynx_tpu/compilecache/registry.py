@@ -68,6 +68,15 @@ class Profile:
                             # default) = non-grid survey, no extra
                             # programs, so plain registries stay a subset
                             # of bucket-grid ones (test_precompile.py).
+    n_noise: int = 0        # DRO noise-list size of a diffp survey: > 0
+                            # adds the pool/DRO slab program set
+                            # (_pool_specs) at parallel/dro.slab_widths —
+                            # the raw jits the precompute/refill and
+                            # shuffle paths dispatch. 0 (default) = no
+                            # diffp, no extra programs, so plain
+                            # registries stay a subset of pooled ones
+                            # (test_precompile.py enforces both
+                            # directions, mirroring n_buckets).
 
 
 BENCH = Profile()
@@ -85,7 +94,7 @@ class ProgramSpec:
 
     name: str               # e.g. "bucketed:pair@2048"
     op: str                 # registry family key (BUCKETED_OPS name, ...)
-    kind: str               # "bucketed" | "pallas" | "fused"
+    kind: str               # "bucketed" | "pallas" | "fused" | "pool"
     phase: str              # survey phase that dispatches it (doc only)
     lower: Callable[[], object]
     dispatched: Callable[[], bool]
@@ -631,6 +640,59 @@ def _fused_specs(p: Profile) -> list:
     return specs
 
 
+def _pool_specs(p: Profile) -> list:
+    """The DRO pool/slab program set of a diffp survey (Profile.n_noise):
+    the RAW jits `parallel.dro` dispatches for precompute (pool refill),
+    noise encryption and the shuffle re-randomization — certified at the
+    exact slab widths `dro.slab_widths` chunks n_noise into, plus the
+    monolithic n_noise width (encrypt_noise / the unchunked path). Empty
+    when n_noise <= 0, so non-diffp registries are a subset of pooled
+    ones (tests/test_precompile.py enforces both directions)."""
+    if p.n_noise <= 0:
+        return []
+    from ..parallel import dro as _dro
+
+    widths = sorted(set(_dro.slab_widths(p.n_noise)) | {p.n_noise})
+
+    def enc_at(w):
+        def go(do="lower"):
+            from ..crypto import elgamal as eg
+
+            args = (_fb_table(), _fb_table(), _scalar(w), _scalar(w))
+            return (eg.encrypt_with_tables(*args) if do == "call"
+                    else eg.encrypt_with_tables.lower(*args))
+        return go
+
+    def i2s_at(w):
+        def go(do="lower"):
+            from ..crypto import elgamal as eg
+
+            args = (_i64(w),)
+            return (eg.int_to_scalar(*args) if do == "call"
+                    else eg.int_to_scalar.lower(*args))
+        return go
+
+    def add_at(w):
+        def go(do="lower"):
+            from ..crypto import elgamal as eg
+
+            args = (_ct(w), _ct(w))
+            return (eg.ct_add(*args) if do == "call"
+                    else eg.ct_add.lower(*args))
+        return go
+
+    specs = []
+    for w in widths:
+        for nm, th in (("encrypt_with_tables", enc_at(w)),
+                       ("int_to_scalar", i2s_at(w)),
+                       ("ct_add", add_at(w))):
+            specs.append(ProgramSpec(
+                f"pool:{nm}@{w}", nm, "pool", "DROPool", th,
+                lambda: True, lambda th=th: th("call"),
+                family="device"))
+    return specs
+
+
 def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     """Enumerate the proofs-on program set for `profile`.
 
@@ -673,7 +735,8 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
 
             specs[name] = ProgramSpec(name, op, "bucketed", phase, lower,
                                       _GATES[gate], call, family=gate)
-    for s in _pallas_specs(profile) + _fused_specs(profile):
+    for s in (_pallas_specs(profile) + _fused_specs(profile)
+              + _pool_specs(profile)):
         specs[s.name] = s
     return list(specs.values())
 

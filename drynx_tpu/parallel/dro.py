@@ -159,6 +159,26 @@ def _chunk_of(size: int, chunk) -> int:
     return int(chunk)
 
 
+def slab_widths(size: int, chunk: int | None = None) -> list[int]:
+    """Distinct dispatch widths the chunked DRO path uses at ``size``
+    (at most two: the slab width and a remainder). The compilecache
+    registry certifies the pool programs at exactly these widths
+    (compilecache/registry._pool_specs, Profile.n_noise)."""
+    if size <= 0:
+        return []
+    eff = _chunk_of(size, chunk)
+    if not eff or eff >= size:
+        return [size]
+    return sorted({min(a + eff, size) - a for a in range(0, size, eff)})
+
+
+# Builder-invocation counter: increments on every FRESH precompute (the
+# expensive fixed-base pass a warm pool exists to skip). The restart test
+# (tests/test_pool.py) asserts it stays flat across a simulated restart
+# with a warm pool — the pooled path must never fall through to here.
+PRECOMPUTE_CALLS = 0
+
+
 def _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk: int, phase: str):
     """Fresh zero-encryptions for blinding scalars r, in `chunk`-wide slabs
     dispatched over the proof plane (element-wise: slab concatenation is
@@ -198,7 +218,10 @@ def precompute_rerandomization(key, pub_tbl, size: int, base_tbl=None,
     over the proof-plane devices (byte-identical to one dispatch; the
     scalars r are always drawn in ONE call so chunking never changes
     them). chunk: None = auto, 0 = force monolithic."""
+    global PRECOMPUTE_CALLS
+
     _require_table(pub_tbl, "precompute_rerandomization")
+    PRECOMPUTE_CALLS += 1
     base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
     r = eg.random_scalars(key, (size,))
     zero_ct = _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk,
@@ -269,22 +292,42 @@ def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None, precomp=None,
 def dro_pipeline(key, pub_tbl: eg.FixedBase, size: int, mean: float,
                  b: float, quanta: float, scale: float = 1.0,
                  limit: float = 0.0, n_servers: int = 3,
-                 chunk: int | None = None):
+                 chunk: int | None = None, pool=None):
     """Full noise phase: generate, encrypt, pass through every server's
-    shuffle+rerandomize. Returns the final encrypted noise list."""
+    shuffle+rerandomize. Returns the final encrypted noise list.
+
+    ``pool`` (a pool.CryptoPool): each server pass first tries to consume
+    ``size`` precomputed zero-encryptions keyed by this public table's
+    digest — the reference's gob-cache economics (precompute dominates at
+    10k..1M noise sizes; a warm pool leaves only permute+add). A short
+    pool falls back to fresh precompute for THAT pass only. Consumption
+    is strictly once (pool/store.py); the permutation is drawn from the
+    pipeline key either way, so pooled output decrypts identically to the
+    fresh-randomness path (tests/test_pool.py pins it)."""
     if not isinstance(pub_tbl, eg.FixedBase):
         raise TypeError("dro_pipeline takes the FixedBase wrapper; pass "
                         "pub_tbl.table only to the shuffle layer")
     noise = generate_noise_values(size, mean, b, quanta, scale, limit)
     key, sub = jax.random.split(key)
     cts = encrypt_noise(sub, pub_tbl, noise)
+    digest = None
+    if pool is not None:
+        from ..pool import store as _ps
+
+        digest = _ps.key_digest(pub_tbl.table)
+    S = int(cts.shape[0])
     for _ in range(n_servers):
         key, sub = jax.random.split(key)
+        pc = None
+        if pool is not None:
+            got = pool.try_consume_dro(digest, S)
+            if got is not None:
+                pc = (jnp.asarray(got[0]), jnp.asarray(got[1]))
         cts, _, _ = shuffle_rerandomize(sub, cts, pub_tbl.table,
-                                        chunk=chunk)
+                                        precomp=pc, chunk=chunk)
     return cts, noise
 
 
 __all__ = ["generate_noise_values", "encrypt_noise", "shuffle_rerandomize",
            "precompute_rerandomization", "save_precompute", "load_precompute",
-           "dro_pipeline", "CHUNK"]
+           "dro_pipeline", "slab_widths", "CHUNK"]

@@ -1,0 +1,49 @@
+"""Cross-survey crypto pools: persistent DRO precompute + sig tables.
+
+See store.py for the disk format and the single-consumption claim
+protocol (load-bearing privacy), replenish.py for the refill crypto.
+
+Process-wide active pool: both tenants are CONTENT-ADDRESSED (DRO slabs
+by collective-key digest, sig tables by A-table digest), so one shared
+pool can never serve an artifact to the wrong key/signature set — which
+makes a process-global handle safe. ``LocalCluster(pool=...)`` activates
+its pool here so deep call sites with no cluster in scope (the sig-table
+LRU miss paths in proofs/range_proof.py) can consult the store; setting
+``DRYNX_POOL_DIR`` activates one lazily for tooling.
+"""
+from __future__ import annotations
+
+import os
+
+from .store import (CryptoPool, DoubleConsumption, InsufficientBalance,
+                    PoolError, key_digest)
+from . import replenish
+
+_ACTIVE: CryptoPool | None = None
+_ENV_POOLS: dict[str, CryptoPool] = {}
+
+
+def activate(pool: CryptoPool | None) -> CryptoPool | None:
+    """Install ``pool`` as the process-wide active pool (None clears)."""
+    global _ACTIVE
+    _ACTIVE = pool
+    return pool
+
+
+def active_pool() -> CryptoPool | None:
+    """The explicitly-activated pool, else one rooted at $DRYNX_POOL_DIR
+    (memoized per path), else None."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    d = os.environ.get("DRYNX_POOL_DIR")
+    if not d:
+        return None
+    p = _ENV_POOLS.get(d)
+    if p is None:
+        p = _ENV_POOLS[d] = CryptoPool(d)
+    return p
+
+
+__all__ = ["CryptoPool", "PoolError", "DoubleConsumption",
+           "InsufficientBalance", "key_digest", "replenish",
+           "activate", "active_pool"]

@@ -1,0 +1,342 @@
+"""Persistent cross-survey crypto-artifact store (the "crypto pool").
+
+The reference amortizes its two heavyweight per-survey setups across
+surveys AND processes: DRO shuffle precomputation is gob-persisted per
+server (services/service.go:34,316-317 pre_compute_multiplications.gob +
+unlynx PrecomputationWritingForShuffling), and the per-signature tables
+are built once per signature set. This module is the repo's equivalent,
+with two tenants:
+
+  * **DRO precompute slabs** — fixed-width batches of fresh
+    zero-encryptions ``(zero_ct (E,2,3,16), r (E,16))`` usable as the
+    ``precomp`` argument of ``parallel.dro.shuffle_rerandomize``. Keyed
+    by a digest of the collective-key fixed-base table (a slab is only
+    valid under the key it was encrypted to) and the slab width.
+  * **Sig tables** — ``sig_gt_table`` / ``sig_gt_pow_tables`` arrays
+    keyed by the same A-table digests the in-process LRUs use
+    (proofs/range_proof.py), so a fresh process skips the pairing batch
+    and the ~10 s host pow-table build.
+
+Single consumption is load-bearing CORRECTNESS, not bookkeeping: reusing
+a DRO re-randomization mask across two surveys lets a proof observer
+subtract the masks and recover both secret permutations — the privacy
+the shuffle exists to provide. The claim protocol therefore tombstones a
+slab BEFORE its ciphertexts are released:
+
+  1. ``os.rename(slab.npz -> slab.npz.claimed)`` — atomic: exactly one
+     claimant (thread OR process) can win; a loser raises
+     ``DoubleConsumption``.
+  2. append a ``consume`` event to the fsync'd ledger journal — the
+     tombstone survives a crash from here on.
+  3. only now read the arrays; then unlink the ``.claimed`` file.
+
+Crash windows: a death between 1 and 3 leaves a ``.claimed`` file whose
+randomness was never served — reopen deletes it (event ``recover``).
+A death mid-write leaves a ``*.tmp`` partial — deposits write tmp +
+fsync + ``os.replace``, so a live ``slab_*.npz`` is always complete and
+reopen just sweeps the partials. A deposit that crashed after the
+``os.replace`` but before its ledger line is simply a live slab with no
+deposit event — servable; only ``consume`` events are load-bearing.
+
+numpy-only on purpose: the store must be importable (and auditable) with
+no accelerator runtime; the crypto lives in ``pool.replenish`` /
+``parallel.dro``.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import secrets
+import threading
+
+import numpy as np
+
+
+class PoolError(Exception):
+    """Base class for pool failures."""
+
+
+class DoubleConsumption(PoolError):
+    """A slab was claimed twice (second claimant, any thread/process).
+
+    This is the error the single-consumption ledger exists to raise:
+    the caller must treat it as 'use different randomness', never as
+    'retry the same slab'."""
+
+
+class InsufficientBalance(PoolError):
+    """The pool cannot cover the requested element count."""
+
+
+def key_digest(table) -> str:
+    """Content digest of a collective-key fixed-base table (64, 16, 3, 16).
+
+    DRO slabs are zero-encryptions UNDER A SPECIFIC KEY — serving a slab
+    encrypted to a different collective key would silently break the
+    re-randomization (the ciphertexts would no longer decrypt to the
+    survey's plaintexts). Content-addressing by the key table makes the
+    mixup structurally impossible."""
+    a = np.ascontiguousarray(np.asarray(table))
+    return hashlib.sha256(a.tobytes()).hexdigest()[:16]
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_npz(path: str, **arrays) -> None:
+    """tmp + fsync + os.replace: a reader never observes a partial file
+    under the final name; a crash leaves only a ``*.tmp`` to sweep."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+class CryptoPool:
+    """One on-disk pool rooted at ``root``.
+
+    Layout::
+
+        root/ledger.jsonl                    append-only event journal
+        root/dro/<digest>/<E>/slab_<id>.npz  live slab (E elements)
+        root/dro/.../slab_<id>.npz.claimed   tombstoned, not yet unlinked
+        root/sig/<kind>_<digest>.npz         content-addressed sig tables
+
+    ``slab_elems`` is the width replenishment deposits at (consumers
+    accept any width present). Thread-safe; multi-process safe for the
+    consumption path (the rename claim is the arbiter — the in-memory
+    consumed-set is an accelerator for the restart case, not the lock).
+    """
+
+    def __init__(self, root: str, slab_elems: int = 4096):
+        self.root = os.path.abspath(root)
+        self.slab_elems = int(slab_elems)
+        self._lock = threading.RLock()
+        self._consumed: set[str] = set()
+        # process-local activity counters (lifetime state is the ledger)
+        self.counters = {"deposited": 0, "consumed": 0, "recovered": 0,
+                         "elements_consumed": 0}
+        os.makedirs(os.path.join(self.root, "dro"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "sig"), exist_ok=True)
+        self._ledger_path = os.path.join(self.root, "ledger.jsonl")
+        self._replay_ledger()
+        self._recover()
+
+    # -- ledger ------------------------------------------------------------
+
+    def _replay_ledger(self) -> None:
+        if not os.path.exists(self._ledger_path):
+            return
+        with open(self._ledger_path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn final line from a crash mid-append: the claim
+                    # rename happened first, so the .claimed sweep below
+                    # still tombstones the slab — drop the torn tail
+                    continue
+                if ev.get("ev") in ("consume", "recover"):
+                    self._consumed.add(ev["slab"])
+
+    def _ledger_append(self, ev: dict) -> None:
+        with self._lock:
+            with open(self._ledger_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(ev, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    # -- crash recovery ----------------------------------------------------
+
+    def _recover(self) -> None:
+        """Sweep crash residue: partial ``*.tmp`` writes are discarded
+        (never visible under a live name); orphaned ``*.claimed`` slabs
+        were tombstoned but never served — their randomness must not
+        re-enter the pool, so they are journaled as ``recover`` and
+        deleted."""
+        pat = os.path.join(self.root, "dro", "**")
+        for p in glob.glob(pat, recursive=True):
+            if p.endswith(".tmp"):
+                os.unlink(p)
+            elif p.endswith(".claimed"):
+                sid = _slab_id(p[:-len(".claimed")])
+                self._ledger_append({"ev": "recover", "slab": sid})
+                self._consumed.add(sid)
+                self.counters["recovered"] += 1
+                os.unlink(p)
+
+    # -- DRO slab tenant ---------------------------------------------------
+
+    def _slab_dir(self, digest: str, elems: int) -> str:
+        return os.path.join(self.root, "dro", digest, str(int(elems)))
+
+    def _live_slabs(self, digest: str) -> list[str]:
+        pat = os.path.join(self.root, "dro", digest, "*", "slab_*.npz")
+        return sorted(glob.glob(pat))
+
+    def deposit_dro(self, digest: str, zero_ct, r) -> str:
+        """Persist one precompute slab; returns its slab id.
+
+        Write-then-journal: the atomic replace makes the slab servable,
+        the deposit event is informational (see module docstring)."""
+        zero_ct = np.asarray(zero_ct)
+        r = np.asarray(r)
+        if zero_ct.shape[0] != r.shape[0]:
+            raise PoolError(f"slab shape mismatch: {zero_ct.shape} vs "
+                            f"{r.shape}")
+        elems = int(zero_ct.shape[0])
+        sid = secrets.token_hex(8)
+        d = self._slab_dir(digest, elems)
+        os.makedirs(d, exist_ok=True)
+        _atomic_write_npz(os.path.join(d, f"slab_{sid}.npz"),
+                          zero_ct=zero_ct, r=r)
+        self._ledger_append({"ev": "deposit", "slab": sid,
+                             "digest": digest, "elems": elems})
+        with self._lock:
+            self.counters["deposited"] += 1
+        return sid
+
+    def dro_balance(self, digest: str) -> int:
+        """Live (unclaimed) elements available under ``digest``."""
+        return sum(_slab_elems(p) for p in self._live_slabs(digest))
+
+    def _consume_path(self, path: str, digest: str):
+        """The claim protocol (see module docstring): rename tombstone ->
+        fsync'd ledger event -> only then read -> unlink."""
+        sid = _slab_id(path)
+        with self._lock:
+            if sid in self._consumed:
+                raise DoubleConsumption(
+                    f"slab {sid} already consumed (ledger)")
+        claimed = f"{path}.claimed"
+        try:
+            os.rename(path, claimed)
+        except FileNotFoundError:
+            # the slab existed when enumerated; only a concurrent claim
+            # removes a live slab file
+            raise DoubleConsumption(
+                f"slab {sid} claimed concurrently") from None
+        self._ledger_append({"ev": "consume", "slab": sid,
+                             "digest": digest,
+                             "elems": _slab_elems(path)})
+        with self._lock:
+            self._consumed.add(sid)
+            self.counters["consumed"] += 1
+            self.counters["elements_consumed"] += _slab_elems(path)
+        with np.load(claimed) as d:
+            out = (d["zero_ct"].copy(), d["r"].copy())
+        os.unlink(claimed)
+        return out
+
+    def consume_slab(self, digest: str, slab_id: str):
+        """Consume one specific slab by id (test/diagnostic surface).
+
+        Raises DoubleConsumption if it was ever consumed — in this
+        process, by a concurrent thread, or by a previous process (the
+        ledger replay covers the restart case)."""
+        with self._lock:
+            if slab_id in self._consumed:
+                raise DoubleConsumption(
+                    f"slab {slab_id} already consumed (ledger)")
+        for p in self._live_slabs(digest):
+            if _slab_id(p) == slab_id:
+                return self._consume_path(p, digest)
+        raise DoubleConsumption(
+            f"slab {slab_id} not live under {digest} (claimed or unknown)")
+
+    def try_consume_dro(self, digest: str, need: int):
+        """Claim >= ``need`` elements and return ``(zero_ct, r)`` trimmed
+        to exactly ``need``; None when the balance cannot cover it.
+
+        Slabs are consumed whole: the unclaimed tail of the last slab is
+        DISCARDED with its tombstone (never re-enters the pool) — the
+        safe direction; wasting randomness is cheap, reusing it is a
+        privacy break."""
+        if need <= 0:
+            return None
+        if self.dro_balance(digest) < need:
+            return None
+        zs, rs, got = [], [], 0
+        for p in self._live_slabs(digest):
+            try:
+                z, r = self._consume_path(p, digest)
+            except DoubleConsumption:
+                continue        # lost a race on this slab; try the next
+            zs.append(z)
+            rs.append(r)
+            got += z.shape[0]
+            if got >= need:
+                break
+        if got < need:
+            # the balance shrank under us: everything claimed above is
+            # already tombstoned and stays discarded
+            raise InsufficientBalance(
+                f"pool drained concurrently: got {got} < need {need}")
+        z = np.concatenate(zs, axis=0)[:need]
+        r = np.concatenate(rs, axis=0)[:need]
+        return z, r
+
+    def consume_dro(self, digest: str, need: int):
+        out = self.try_consume_dro(digest, need)
+        if out is None:
+            raise InsufficientBalance(
+                f"balance {self.dro_balance(digest)} < need {need}")
+        return out
+
+    # -- sig-table tenant --------------------------------------------------
+
+    def _sig_path(self, kind: str, digest: str) -> str:
+        assert "/" not in kind and "/" not in digest, (kind, digest)
+        return os.path.join(self.root, "sig", f"{kind}_{digest}.npz")
+
+    def save_sig(self, kind: str, digest: str, **arrays) -> None:
+        """Content-addressed, idempotent: overwriting with the same
+        digest rewrites identical bytes."""
+        _atomic_write_npz(self._sig_path(kind, digest), **arrays)
+
+    def load_sig(self, kind: str, digest: str):
+        p = self._sig_path(kind, digest)
+        if not os.path.exists(p):
+            return None
+        with np.load(p) as d:
+            return {k: d[k].copy() for k in d.files}
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        pat = os.path.join(self.root, "dro", "*", "*", "slab_*.npz")
+        live = glob.glob(pat)
+        return {
+            "slabs_live": len(live),
+            "elements_live": sum(_slab_elems(p) for p in live),
+            "slab_elems": self.slab_elems,
+            **self.counters,
+        }
+
+
+def _slab_id(path: str) -> str:
+    stem = os.path.basename(path)
+    assert stem.startswith("slab_") and stem.endswith(".npz"), path
+    return stem[len("slab_"):-len(".npz")]
+
+
+def _slab_elems(path: str) -> int:
+    # width is the parent directory name (root/dro/<digest>/<E>/slab_*.npz)
+    return int(os.path.basename(os.path.dirname(path)))
+
+
+__all__ = ["CryptoPool", "PoolError", "DoubleConsumption",
+           "InsufficientBalance", "key_digest"]

@@ -54,6 +54,10 @@ def main(argv=None) -> int:
                          "frequency_count/union/inter); above the tile "
                          "threshold adds the bucket-tile program set at "
                          "tile-derived shard sizes")
+    ap.add_argument("--noise", type=int, default=0,
+                    help="DRO noise-list size of a diffp survey; > 0 adds "
+                         "the pool/slab program set (precompute refill + "
+                         "shuffle) at dro.slab_widths chunk widths")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -75,7 +79,8 @@ def main(argv=None) -> int:
                          n_values=args.values, u=args.range_u,
                          l=args.range_l, dlog_limit=args.dlog_limit,
                          n_shards=n_shards, n_queue=max(1, args.queue),
-                         n_buckets=max(0, args.buckets))
+                         n_buckets=max(0, args.buckets),
+                         n_noise=max(0, args.noise))
 
     if args.list:
         specs = cc.build_registry(profile)

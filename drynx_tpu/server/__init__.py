@@ -6,7 +6,7 @@ threading rules the scheduler inherits from the compilecache subsystem.
 """
 from .admission import (Admission, AdmissionController, AdmissionError,
                         QueueFull)
-from .scheduler import SurveyServer, pipeline_overlap
+from .scheduler import SurveyServer, pipeline_overlap, refill_overlap
 from .transcript import survey_transcript, transcript_digest
 
 __all__ = [
@@ -16,6 +16,7 @@ __all__ = [
     "QueueFull",
     "SurveyServer",
     "pipeline_overlap",
+    "refill_overlap",
     "survey_transcript",
     "transcript_digest",
 ]

@@ -37,9 +37,12 @@ class Admission:
     """Triage verdict for one submitted survey."""
 
     survey_id: str
-    lane: str                     # "fast" | "compile"
+    lane: str                     # "fast" | "compile" | "refill"
     profile: object = None        # cc.Profile; None for proofs-off surveys
     missing: tuple = ()           # registry program names not yet warm
+    dro_need: int = 0             # pool elements the survey's DRO phase
+                                  # consumes (n_cns * noise_list_size);
+                                  # 0 for non-diffp surveys
 
 
 class AdmissionController:
@@ -75,7 +78,30 @@ class AdmissionController:
             n_values=max(len(ranges), 1), u=int(u0) or 16,
             l=int(l0) or 5, dlog_limit=self.cluster.dlog.limit,
             n_shards=plane.n_shards(), n_queue=self.n_queue,
-            n_buckets=st.grid_buckets(q))
+            n_buckets=st.grid_buckets(q),
+            n_noise=self._noise_size(q))
+
+    @staticmethod
+    def _noise_size(q) -> int:
+        # queries without a diffp block (proofs-off stubs, legacy
+        # shapes) have no noise phase at all
+        d = getattr(q, "diffp", None)
+        if d is None or not d.enabled():
+            return 0
+        return int(d.noise_list_size)
+
+    def dro_need_for(self, sq) -> int:
+        """Pool elements the survey's DRO phase consumes: one noise-list
+        precompute per CN pass (service.execute_survey's shuffle chain)."""
+        n = self._noise_size(sq.query)
+        return len(self.cluster.cns) * n if n else 0
+
+    def _pool_digest(self) -> str:
+        if not hasattr(self, "_digest"):
+            from .. import pool as pool_mod
+
+            self._digest = pool_mod.key_digest(self.cluster.coll_tbl.table)
+        return self._digest
 
     @staticmethod
     def needed(profile: cc.Profile) -> set[str]:
@@ -96,14 +122,26 @@ class AdmissionController:
             self._warm |= names
 
     def triage(self, sq) -> Admission:
+        """Lane order: cold programs -> "compile"; warm programs but a
+        pool balance short of the survey's noise need -> "refill" (the
+        scheduler deposits slabs cooperatively, then re-triages); else
+        "fast". A cluster without a pool never sees the refill lane —
+        the DRO phase pays fresh precompute inline, exactly as before."""
         profile = self.profile_for(sq)
+        need = self.dro_need_for(sq)
+        missing: tuple = ()
         if profile is None:
-            return Admission(survey_id=sq.survey_id, lane="fast")
-        with self._lock:
-            missing = tuple(sorted(self.needed(profile) - self._warm))
-        return Admission(survey_id=sq.survey_id,
-                         lane="compile" if missing else "fast",
-                         profile=profile, missing=missing)
+            lane = "fast"
+        else:
+            with self._lock:
+                missing = tuple(sorted(self.needed(profile) - self._warm))
+            lane = "compile" if missing else "fast"
+        pool = getattr(self.cluster, "pool", None)
+        if (lane == "fast" and need > 0 and pool is not None
+                and pool.dro_balance(self._pool_digest()) < need):
+            lane = "refill"
+        return Admission(survey_id=sq.survey_id, lane=lane,
+                         profile=profile, missing=missing, dro_need=need)
 
 
 __all__ = ["Admission", "AdmissionController", "AdmissionError",

@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import queue
+import secrets
 import threading
 import time
 
@@ -96,6 +97,13 @@ class SurveyServer:
         self.timers = PhaseTimers()
         self._fast: collections.deque = collections.deque()
         self._compile: collections.deque = collections.deque()
+        # refill lane: surveys whose programs are warm but whose DRO
+        # noise need exceeds the pool balance (admission lane "refill").
+        # The drain thread deposits ONE slab per iteration — cooperative,
+        # fast-lane-preemptible, same pattern as the compile lane — so
+        # refill overlaps the verify worker (the pipeline gaps).
+        self._refill: collections.deque = collections.deque()
+        self.refill_slabs = 0
         self._results: dict[str, object] = {}
         self._errors: dict[str, Exception] = {}
         self._admissions: dict[str, adm.Admission] = {}
@@ -109,15 +117,15 @@ class SurveyServer:
         """Triage + enqueue. Raises QueueFull at max_depth (typed
         rejection — the caller backs off; nothing is dropped silently)."""
         with self._lock:
-            depth = len(self._fast) + len(self._compile)
+            depth = (len(self._fast) + len(self._compile)
+                     + len(self._refill))
             if depth >= self.max_depth:
                 raise adm.QueueFull(
                     f"queue at max_depth={self.max_depth}; survey "
                     f"{sq.survey_id!r} rejected")
             a = self.admission.triage(sq)
             self._admissions[sq.survey_id] = a
-            lane = self._compile if a.lane == "compile" else self._fast
-            lane.append(_Entry(sq=sq, seed=seed, admission=a))
+            self._route_locked(_Entry(sq=sq, seed=seed, admission=a))
         return a
 
     def prewarm(self, sq) -> adm.Admission:
@@ -130,6 +138,14 @@ class SurveyServer:
 
     def admission_of(self, survey_id: str) -> adm.Admission | None:
         return self._admissions.get(survey_id)
+
+    def _route_locked(self, entry: _Entry) -> None:
+        """Append an entry to the deque its admission lane names
+        (caller holds self._lock)."""
+        lane = {"compile": self._compile,
+                "refill": self._refill}.get(entry.admission.lane,
+                                            self._fast)
+        lane.append(entry)
 
     # -- compile lane (cooperative, drain thread only) ---------------------
 
@@ -161,7 +177,36 @@ class SurveyServer:
         entry.admission = self.admission.triage(entry.sq)
         with self._lock:
             self._admissions[sid] = entry.admission
-            self._fast.append(entry)
+            # now warm — but a short pool still routes it via refill
+            self._route_locked(entry)
+
+    # -- refill lane (cooperative, drain thread only) ----------------------
+
+    def _refill_step(self, entry: _Entry) -> None:
+        """Deposit ONE pool slab toward this entry's DRO need, then
+        re-triage. Runs on the drain thread under the proof-device lock
+        (the slab precompute is a real device dispatch — same threading
+        contract as the compile lane), so it fills the encode/verify
+        pipeline gaps: while the verify worker grinds survey N, the
+        drain thread banks randomness for survey N+1."""
+        from .. import pool as pool_mod
+
+        sid = entry.sq.survey_id
+        pool = self.cluster.pool
+        t0 = time.perf_counter()
+        with self.cluster._proof_device_lock:
+            cc.trace_guard()
+            import jax
+
+            k = jax.random.PRNGKey(secrets.randbits(63))
+            pool_mod.replenish.refill_slab(pool, k,
+                                           self.cluster.coll_tbl.table)
+        self.refill_slabs += 1
+        self.timers.span(f"Refill.{sid}", t0, time.perf_counter())
+        entry.admission = self.admission.triage(entry.sq)
+        with self._lock:
+            self._admissions[sid] = entry.admission
+            self._route_locked(entry)
 
     # -- drain loop --------------------------------------------------------
 
@@ -174,15 +219,25 @@ class SurveyServer:
         while True:
             group = None
             entry = None
+            rentry = None
             with self._lock:
+                # fast work first, then compile (it unblocks encodes
+                # that feed the verify pipeline), then refill — the
+                # refill lane is pure gap work: slab deposits overlap
+                # whatever the verify worker is grinding, and nothing
+                # downstream waits on them until their survey is next
                 if self._fast:
                     group = self._pop_group_locked()
                 elif self._compile:
                     entry = self._compile.popleft()
+                elif self._refill:
+                    rentry = self._refill.popleft()
                 else:
                     break
             if group is not None:
                 self._run_group(group)
+            elif rentry is not None:
+                self._refill_step(rentry)
             elif entry is not None:
                 self._promote(entry)
         self._verify_q.join()
@@ -284,6 +339,20 @@ class SurveyServer:
                                  t0, time.perf_counter())
 
 
+def refill_overlap(timers: PhaseTimers) -> float:
+    """Seconds of wall-clock during which a pool-refill step overlapped
+    some survey's verification — the amortization proof the acceptance
+    JSON reports (> 0 iff refill ran in a pipeline gap instead of
+    serializing in front of its survey)."""
+    refills = timers.spans("Refill.")
+    verifies = timers.spans("Pipeline.verify.")
+    total = 0.0
+    for _, r0, r1 in refills:
+        for _, v0, v1 in verifies:
+            total += max(0.0, min(r1, v1) - max(r0, v0))
+    return total
+
+
 def pipeline_overlap(timers: PhaseTimers) -> float:
     """Seconds of wall-clock during which some survey's encode span
     intersects a DIFFERENT survey's verify span — the pipelining proof
@@ -301,4 +370,4 @@ def pipeline_overlap(timers: PhaseTimers) -> float:
     return total
 
 
-__all__ = ["SurveyServer", "pipeline_overlap"]
+__all__ = ["SurveyServer", "pipeline_overlap", "refill_overlap"]

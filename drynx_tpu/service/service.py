@@ -117,7 +117,7 @@ class LocalCluster:
     def __init__(self, n_cns: int = 3, n_dps: int = 5, n_vns: int = 3,
                  seed: int = 1, dlog_limit: int = 10000,
                  link=None, share_verify_cache: bool = True,
-                 precompile: str = "auto"):
+                 precompile: str = "auto", pool=None):
         # precompile: "auto" warms the proofs-on kernel set on the MAIN
         # thread before the first proofs-on survey WHEN the Pallas backend
         # is up (where _async_proof uses real threads — first-touch tracing
@@ -132,6 +132,15 @@ class LocalCluster:
         from .transport import LinkModel
 
         self.link = link if link is not None else LinkModel()
+        # persistent crypto pool (drynx_tpu.pool): activated BEFORE any
+        # fixed-base table build so the fb tenant serves the cluster's
+        # own key tables; the DRO digest is derived once coll_tbl exists
+        self.pool = pool
+        self._pool_digest: Optional[str] = None
+        if pool is not None:
+            from .. import pool as pool_mod
+
+            pool_mod.activate(pool)
         rng = np.random.default_rng(seed)
         self.rng = rng
         self.cns = [_new_identity(f"cn{i}", rng) for i in range(n_cns)]
@@ -181,6 +190,15 @@ class LocalCluster:
                                             if share_verify_cache
                                             else VerifyCache(maxsize=0)))
                 for i, v in enumerate(self.vn_idents)])
+
+        # DRO slab tenant: the noise phase below consumes slabs under the
+        # collective-key digest (all tenants are content-addressed —
+        # collective-key / A-table / affine-point digests — so a shared
+        # pool can never serve an artifact to the wrong key)
+        if pool is not None:
+            from .. import pool as pool_mod
+
+            self._pool_digest = pool_mod.key_digest(self.coll_tbl.table)
 
         self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
         self.surveys: dict[str, Survey] = {}
@@ -508,7 +526,9 @@ class LocalCluster:
             n_values=max(len(ranges), 1), u=int(u0) or 16,
             l=int(l0) or 5, dlog_limit=self.dlog.limit,
             n_shards=plane.n_shards(),
-            n_buckets=st.grid_buckets(q))
+            n_buckets=st.grid_buckets(q),
+            n_noise=(int(q.diffp.noise_list_size)
+                     if q.diffp.enabled() else 0))
         with self._proof_device_lock:
             cc.trace_guard()
             before = cc.STATS.totals()
@@ -746,6 +766,15 @@ class LocalCluster:
                             os.unlink(pc_path)
                         except OSError:
                             pass
+                if pc is None and self.pool is not None:
+                    # persistent pool (drynx_tpu.pool): slabs are claimed
+                    # strictly-once (tombstoned before release) and keyed
+                    # by the collective-key digest; a short pool falls
+                    # through to fresh precompute for this pass only
+                    got = self.pool.try_consume_dro(self._pool_digest,
+                                                    int(n_cts.shape[0]))
+                    if got is not None:
+                        pc = (jnp.asarray(got[0]), jnp.asarray(got[1]))
                 if pc is None:
                     key, k_pc = jax.random.split(key)
                     pc = dro.precompute_rerandomization(

@@ -1,0 +1,301 @@
+"""Crypto-pool bench: MEASURED pooled-vs-unpooled per-survey DRO cost.
+
+The DRO phase of a diffp survey pays two crypto costs per server pass:
+the zero-encryption precompute (the hot cost — one fixed-base encrypt
+per noise element) and the permute+rerandomize shuffle. The persistent
+pool (drynx_tpu/pool) moves the precompute out of the survey into
+background refill slabs, so the pooled survey pays only claim + shuffle.
+This harness measures BOTH paths end to end at each noise size — no
+projection anywhere:
+
+  * fill      — timed ``replenish.refill_to`` at the full noise size:
+                the real background cost the refill lane amortizes
+                across pipeline gaps (includes the slab npz writes);
+  * unpooled  — timed fresh ``dro.precompute_rerandomization`` at the
+                full size + shuffle: what every survey pays without a
+                pool (kernels warm — the fill already compiled them);
+  * pooled    — timed ``pool.consume_dro`` (atomic claim + ledger +
+                read) + the same shuffle over the claimed slabs;
+  * ledger    — DURING the run, one slab is claimed twice and the
+                second claim must raise DoubleConsumption: the bench
+                asserts the single-consumption guarantee on the very
+                store instance whose numbers it reports.
+
+Supervisor pattern (bench.py): the parent never imports jax; each noise
+size runs in its own child with a progressive record, so an OOM at 100k
+leaves the 10k point behind.
+
+Usage:
+  python scripts/bench_pool.py --cpu            # 10k + 100k, ~20 min
+  python scripts/bench_pool.py --cpu --smoke    # check.sh tier, <1 min
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402  (jax-free supervisor helpers)
+
+RECORD = os.path.join(ROOT, "BENCH_POOL_r01.json")
+CHILD_TIMEOUT_S = float(os.environ.get("DRYNX_POOL_CHILD_TIMEOUT_S", 2400))
+
+POINTS = [10000, 100000]     # reference diffPri.py noise-list sizes
+SMOKE_POINT = 512            # check.sh `pool` tier, slab 256, <1 min
+
+
+def log(msg):
+    print(f"[pool] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent (jax-free)
+# ---------------------------------------------------------------------------
+
+def point_result(n, outcome, rc, elapsed_s, record):
+    rec = dict(record or {})
+    stage = rec.pop("stage", None)
+    base = {"n_noise": int(n), "outcome": outcome, "rc": rc,
+            "elapsed_s": round(elapsed_s, 1)}
+    if outcome == "ok" and stage == "complete":
+        base["status"] = "ok"
+        base.update(rec)
+        return base
+    if outcome == "ok":
+        base["status"] = "child_exited_without_record"
+    elif outcome == "timeout":
+        base["status"] = "timeout"
+    elif outcome.startswith("signal:"):
+        base["status"] = "killed_" + outcome.split(":", 1)[1].lower()
+    else:
+        base["status"] = "failed_" + outcome.replace(":", "")
+    base["last_stage"] = stage or "none"
+    base.update(rec)
+    return base
+
+
+def write_progressive(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _arm_parent():
+    def _bye(signum, frame):
+        child = bench._CURRENT_CHILD
+        if child is not None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _bye)
+    signal.signal(signal.SIGINT, _bye)
+
+
+def main_parent(args):
+    _arm_parent()
+    points = [SMOKE_POINT] if args.smoke else POINTS
+    timeout = args.timeout or (120 if args.smoke else CHILD_TIMEOUT_S)
+    doc = {"round": "r09", "smoke": bool(args.smoke),
+           "backend": "cpu" if args.cpu else "default",
+           "child_timeout_s": timeout, "points": []}
+    out = args.out or RECORD
+    record_path = os.path.join(ROOT, ".pool_point_record.json")
+
+    for n in points:
+        try:
+            os.remove(record_path)
+        except OSError:
+            pass
+        env = dict(os.environ)
+        if args.cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            # AVX2 only, never opt-level 0 — these points are
+            # execution-dominated (see bench_scale_axes.py)
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_cpu_max_isa" not in flags:
+                flags += " --xla_cpu_max_isa=AVX2"
+            env["XLA_FLAGS"] = flags.strip()
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--measure-child", "--point", str(n),
+               "--record-path", record_path]
+        if args.smoke:
+            cmd.append("--smoke")
+        if args.cpu:
+            cmd.append("--cpu")
+        log(f"n_noise={n}: starting child (timeout {timeout:.0f}s)")
+        outcome, rc, elapsed, _out = bench.supervise_child(
+            cmd, timeout, env=env)
+        pt = point_result(n, outcome, rc, elapsed,
+                          bench.read_record(record_path))
+        print(json.dumps(pt), flush=True)
+        doc["points"].append(pt)
+        if not args.smoke or args.out:
+            write_progressive(out, doc)
+    try:
+        os.remove(record_path)
+    except OSError:
+        pass
+    bad = [p for p in doc["points"] if p.get("status") != "ok"
+           or not p.get("double_consumption_asserted")]
+    log(f"done: {len(doc['points'])} points, {len(bad)} not ok")
+    return 1 if bad else 0
+
+
+# ---------------------------------------------------------------------------
+# Child (one noise size; all jax work below)
+# ---------------------------------------------------------------------------
+
+_REC_PATH = None
+_REC = {}
+
+
+def wr(stage, **fields):
+    _REC.update(fields)
+    _REC["stage"] = stage
+    if _REC_PATH is None:
+        return
+    tmp = _REC_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_REC, f)
+    os.replace(tmp, _REC_PATH)
+
+
+def child(n, smoke):
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from drynx_tpu import pool as pool_mod
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.parallel import dro
+    from drynx_tpu.pool import replenish
+
+    slab = 256 if smoke else 4096
+    rng = np.random.default_rng(8)
+    _, pub = eg.keygen(rng)
+    tbl = eg.pub_table(pub)
+    digest = pool_mod.key_digest(tbl.table)
+    pool = pool_mod.CryptoPool(tempfile.mkdtemp(prefix="drynx_bench_pool_"),
+                               slab_elems=slab)
+    wr("setup", slab_elems=slab)
+
+    # compile warmup at every chunk width both paths dispatch (the fresh
+    # path chunks at dro.slab_widths(n); the fill path at slab_elems) —
+    # the compile cost belongs to neither path's per-survey number
+    for i, w in enumerate(sorted(set(dro.slab_widths(n)) | {slab})):
+        jax.block_until_ready(
+            dro.precompute_rerandomization(jax.random.PRNGKey(8 + i),
+                                           tbl.table, w))
+    wr("warmup", warm_widths=sorted(set(dro.slab_widths(n)) | {slab}))
+
+    # fill: the real background refill cost (precompute + slab writes)
+    t0 = time.perf_counter()
+    slabs = replenish.refill_to(pool, jax.random.PRNGKey(20), tbl.table, n)
+    fill_s = time.perf_counter() - t0
+    wr("fill", fill_s=round(fill_s, 2), fill_slabs=slabs,
+       balance=pool.dro_balance(digest))
+
+    # ledger: claim one extra slab twice on THIS store — the second
+    # claim must raise (single-consumption is the privacy guarantee)
+    sid = replenish.refill_slab(pool, jax.random.PRNGKey(21), tbl.table)
+    pool.consume_slab(digest, sid)
+    try:
+        pool.consume_slab(digest, sid)
+    except pool_mod.DoubleConsumption:
+        wr("ledger", double_consumption_asserted=True)
+    else:
+        raise AssertionError("second claim of a consumed slab succeeded "
+                             "— single-consumption ledger is broken")
+
+    # unpooled survey: fresh precompute at full n (warm) + shuffle
+    t0 = time.perf_counter()
+    fresh = dro.precompute_rerandomization(jax.random.PRNGKey(22),
+                                           tbl.table, n)
+    jax.block_until_ready(fresh)
+    fresh_s = time.perf_counter() - t0
+    # the zero-encryptions double as the input ciphertext pool: shuffle
+    # cost depends only on the element count, not the plaintexts
+    cts = fresh[0]
+    ks = jax.random.PRNGKey(23)
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        dro.shuffle_rerandomize(ks, cts, tbl.table, precomp=fresh))
+    shuffle_compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        dro.shuffle_rerandomize(ks, cts, tbl.table, precomp=fresh))
+    shuffle_fresh_s = time.perf_counter() - t0
+    unpooled_s = fresh_s + shuffle_fresh_s
+    wr("unpooled", precompute_fresh_s=round(fresh_s, 2),
+       shuffle_compile_s=round(shuffle_compile_s, 2),
+       shuffle_fresh_s=round(shuffle_fresh_s, 3),
+       unpooled_survey_s=round(unpooled_s, 2))
+
+    # pooled survey: atomic claim + ledger + read, then the same shuffle
+    t0 = time.perf_counter()
+    z, r = pool.consume_dro(digest, n)
+    consume_s = time.perf_counter() - t0
+    pc = (jnp.asarray(z), jnp.asarray(r))
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        dro.shuffle_rerandomize(ks, pc[0], tbl.table, precomp=pc))
+    shuffle_pooled_s = time.perf_counter() - t0
+    pooled_s = consume_s + shuffle_pooled_s
+    wr("complete", consume_s=round(consume_s, 3),
+       shuffle_pooled_s=round(shuffle_pooled_s, 3),
+       pooled_survey_s=round(pooled_s, 3),
+       elements_consumed=pool.stats()["elements_consumed"],
+       unpooled_survey_s=round(unpooled_s, 2),
+       speedup=round(unpooled_s / pooled_s, 1))
+
+
+def main_child(args):
+    global _REC_PATH
+    _REC_PATH = args.record_path
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, file=sys.stderr)
+    faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    wr("start", smoke=bool(args.smoke))
+    child(args.point, args.smoke)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pooled-vs-unpooled DRO bench (supervised children)")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="one tiny point (check.sh pool tier, <1 min)")
+    ap.add_argument("--out", default=None,
+                    help=f"record path (default {RECORD})")
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("--measure-child", action="store_true")
+    ap.add_argument("--point", type=int, default=None)
+    ap.add_argument("--record-path", default=None)
+    args = ap.parse_args(argv)
+
+    if args.measure_child:
+        if args.cpu:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return main_child(args)
+    return main_parent(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

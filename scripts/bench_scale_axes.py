@@ -23,11 +23,22 @@ projected linearly, with the measured basis recorded on the point
 (`*_basis_n`, `*_projected_s`) — never silently truncated. The pure-host
 phases (tiled encode, vectorized noise generation) always run at full n.
 
+`--pool` reruns the dro axis in POOLED mode (BENCH_SCALE_r02.json): the
+per-survey cost with a warm crypto pool (drynx_tpu/pool) is claim +
+shuffle instead of precompute + shuffle. The claim is measured over a
+real deposited slab (basis recorded, projected per-slab); the shuffle
+runs at the FULL noise size, measured — the element-wise crypto is
+data-independent, so the slab's zero-encryptions tiled to n carry the
+true full-n cost without a multi-hour fill (bench-only shortcut: reusing
+slab randomness would be a privacy break in production, but here only
+the timing is consumed).
+
 Usage:
   python scripts/bench_scale_axes.py --cpu            # full CPU grid
   python scripts/bench_scale_axes.py --cpu --smoke    # check.sh tier,
                                                       # tiny grids, <1 min
   python scripts/bench_scale_axes.py --cpu --axes minmax,dro
+  python scripts/bench_scale_axes.py --cpu --pool     # pooled dro axis
 """
 import argparse
 import json
@@ -43,6 +54,7 @@ sys.path.insert(0, ROOT)
 import bench  # noqa: E402  (jax-free supervisor helpers)
 
 RECORD = os.path.join(ROOT, "BENCH_SCALE_r01.json")
+POOL_RECORD = os.path.join(ROOT, "BENCH_SCALE_r02.json")
 CHILD_TIMEOUT_S = float(os.environ.get("DRYNX_SCALE_CHILD_TIMEOUT_S", 900))
 
 # The three reference axes. minmax: bucket range R of a min/max survey
@@ -132,18 +144,23 @@ def _arm_parent():
 def main_parent(args):
     _arm_parent()
     grids = SMOKE_GRIDS if args.smoke else GRIDS
-    axes = [a.strip() for a in args.axes.split(",")] if args.axes \
-        else list(grids)
+    if args.pool:
+        # pooled mode is a dro-axis rerun; other axes have no pool path
+        axes = ["dro"]
+    else:
+        axes = [a.strip() for a in args.axes.split(",")] if args.axes \
+            else list(grids)
     for a in axes:
         if a not in grids:
             raise SystemExit(f"unknown axis {a!r} (have {list(grids)})")
 
     timeout = args.timeout or (120 if args.smoke else CHILD_TIMEOUT_S)
-    doc = {"round": "r08", "smoke": bool(args.smoke),
+    doc = {"round": "r09-pool" if args.pool else "r08",
+           "smoke": bool(args.smoke), "pool": bool(args.pool),
            "backend": "cpu" if args.cpu else "default",
            "child_timeout_s": timeout,
            "grids": {a: grids[a] for a in axes}, "points": []}
-    out = args.out or RECORD
+    out = args.out or (POOL_RECORD if args.pool else RECORD)
     record_path = os.path.join(ROOT, ".scale_point_record.json")
 
     for axis in axes:
@@ -170,6 +187,8 @@ def main_parent(args):
                 cmd.append("--smoke")
             if args.cpu:
                 cmd.append("--cpu")
+            if args.pool:
+                cmd.append("--pool")
             log(f"{axis} n={n}: starting child (timeout {timeout:.0f}s)")
             outcome, rc, elapsed, _out = bench.supervise_child(
                 cmd, timeout, env=env)
@@ -368,6 +387,74 @@ def child_dro(n, smoke):
        shuffle_projected_s=round(s_warm * (n / m), 1))
 
 
+def child_dro_pool(n, smoke):
+    """One pooled-DRO point: per-survey cost with a warm crypto pool —
+    slab claim (measured over a real deposited slab, projected per-slab)
+    plus the permute+rerandomize shuffle at the FULL noise size,
+    measured. The unpooled precompute basis is measured alongside so the
+    point carries its own pooled-vs-unpooled comparison."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from drynx_tpu import pool as pool_mod
+    from drynx_tpu.crypto import elgamal as eg
+    from drynx_tpu.parallel import dro
+    from drynx_tpu.pool import replenish
+
+    slab = 256 if smoke else min(n, DRO_MEAS_CAP)
+    rng = np.random.default_rng(8)
+    _, pub = eg.keygen(rng)
+    tbl = eg.pub_table(pub)
+    digest = pool_mod.key_digest(tbl.table)
+    pool = pool_mod.CryptoPool(
+        tempfile.mkdtemp(prefix="drynx_scale_pool_"), slab_elems=slab)
+    n_slabs = -(-n // slab)
+    wr("setup", slab_elems=slab, slabs_needed=n_slabs)
+
+    # warm the precompute at the slab width, then measure the unpooled
+    # basis (same numbers the plain dro axis projects from)
+    key = jax.random.PRNGKey(8)
+    p_cold, _ = _timed(
+        lambda: dro.precompute_rerandomization(key, tbl.table, slab))
+    p_warm, _ = _timed(
+        lambda: dro.precompute_rerandomization(key, tbl.table, slab))
+    wr("precompute", precompute_warm_s=round(p_warm, 3), dro_basis_n=slab,
+       precompute_projected_s=round(p_warm * (n / slab), 1))
+
+    # claim cost over a real deposited slab (atomic rename + fsync'd
+    # ledger append + npz read), projected across the slabs a full-n
+    # consume would claim
+    replenish.refill_slab(pool, jax.random.PRNGKey(9), tbl.table)
+    t0 = time.perf_counter()
+    z, r = pool.consume_dro(digest, slab)
+    consume_s = time.perf_counter() - t0
+    wr("claim", consume_slab_s=round(consume_s, 4),
+       consume_projected_s=round(consume_s * n_slabs, 2))
+
+    # full-n shuffle, MEASURED: tile the slab's real zero-encryptions to
+    # n — element-wise crypto is data-independent, so the tiled batch
+    # carries the true cost (bench-only: tiled randomness is never used)
+    reps = -(-n // slab)
+    pc = (jnp.asarray(np.tile(z, (reps, 1, 1, 1))[:n]),
+          jnp.asarray(np.tile(r, (reps, 1))[:n]))
+    cts = pc[0]
+    ks = jax.random.PRNGKey(10)
+    s_cold, _ = _timed(lambda: dro.shuffle_rerandomize(
+        ks, cts, tbl.table, precomp=pc))
+    s_warm, _ = _timed(lambda: dro.shuffle_rerandomize(
+        ks, cts, tbl.table, precomp=pc))
+    pooled = consume_s * n_slabs + s_warm
+    unpooled = p_warm * (n / slab) + s_warm
+    wr("complete", shuffle_cold_s=round(s_cold, 2),
+       shuffle_full_s=round(s_warm, 2), shuffle_n=n,
+       pooled_survey_s=round(pooled, 2),
+       unpooled_survey_projected_s=round(unpooled, 1),
+       speedup_projected=round(unpooled / pooled, 1))
+
+
 def main_child(args):
     global _REC_PATH
     _REC_PATH = args.record_path
@@ -380,6 +467,9 @@ def main_child(args):
 
         jax.config.update("jax_platforms", "cpu")
     wr("start", smoke=bool(args.smoke))
+    if args.pool:
+        child_dro_pool(args.point, args.smoke)
+        return 0
     {"minmax": child_minmax, "rows": child_rows,
      "dro": child_dro}[args.axis](args.point, args.smoke)
     return 0
@@ -393,6 +483,9 @@ def main(argv=None):
                     help="tiny grids + no proof phase (check.sh tier)")
     ap.add_argument("--axes", default=None,
                     help="comma list of axes (default: all)")
+    ap.add_argument("--pool", action="store_true",
+                    help="pooled-DRO rerun of the dro axis "
+                         f"(record {os.path.basename(POOL_RECORD)})")
     ap.add_argument("--out", default=None,
                     help=f"record path (default {RECORD})")
     ap.add_argument("--timeout", type=float, default=None)

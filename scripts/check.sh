@@ -41,6 +41,9 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
 echo "== scale smoke (tiny grid points, one supervised child per point) =="
 python scripts/bench_scale_axes.py --cpu --smoke > /dev/null
 
+echo "== pool smoke (store lifecycle: create->persist->reopen->consume->refill) =="
+python scripts/pool_smoke.py > /dev/null
+
 echo "== server tier (standing scheduler quick tests + 3-survey demo) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not slow' \
     tests/test_server.py

@@ -13,7 +13,14 @@ summary:
   * pipelining  — PhaseTimers absolute spans prove survey N+1's encode
                   overlapped survey N's verification;
   * thread rule — batching.TRACE_HOOK observes zero first-touch jit
-                  traces off the main thread (the r05 segfault class).
+                  traces off the main thread (the r05 segfault class);
+  * crypto pool — a fourth survey (diffp, noise list 8) arrives with an
+                  EMPTY persistent pool and is admitted via the refill
+                  lane: the drain thread deposits precompute slabs in the
+                  pipeline gaps, then the survey runs pooled (zero fresh
+                  precompute inside the survey). The JSON reports the
+                  pool stats (balance, slabs consumed/refilled, refill
+                  seconds overlapped with verification).
 
 Usage: python scripts/serve_surveys.py            (~2 min cold on CPU)
 """
@@ -34,10 +41,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def build_cluster(seed=13, data_seed=5):
+def build_cluster(seed=13, data_seed=5, pool=None):
     from drynx_tpu.service.service import LocalCluster
 
-    cl = LocalCluster(n_cns=2, n_dps=2, n_vns=2, seed=seed, dlog_limit=4000)
+    cl = LocalCluster(n_cns=2, n_dps=2, n_vns=2, seed=seed, dlog_limit=4000,
+                      pool=pool)
     rng = np.random.default_rng(data_seed)
     per_dp = {}
     for name, dp in cl.dps.items():
@@ -59,11 +67,24 @@ def queries(cl):
                survey_id="s2")]
 
 
+def diffp_query(cl):
+    from drynx_tpu.service.query import DiffPParams
+
+    return cl.generate_survey_query(
+        "sum", query_min=0, query_max=15, survey_id="s3",
+        diffp=DiffPParams(noise_list_size=8, lap_mean=0.0, lap_scale=2.0,
+                          quanta=1.0, scale=1.0, limit=4.0))
+
+
 def main():
+    import tempfile
+
+    from drynx_tpu import pool as pool_mod
     from drynx_tpu.crypto import batching as B
+    from drynx_tpu.parallel import dro
     from drynx_tpu.proofs import requests as rq
     from drynx_tpu.server import (SurveyServer, pipeline_overlap,
-                                  transcript_digest)
+                                  refill_overlap, transcript_digest)
 
     t0 = time.time()
     events = []
@@ -73,9 +94,12 @@ def main():
         with rec:
             events.append((name, threading.current_thread().name))
 
-    cl, per_dp = build_cluster()
+    pool = pool_mod.CryptoPool(tempfile.mkdtemp(prefix="drynx_pool_"),
+                               slab_elems=8)
+    cl, per_dp = build_cluster(pool=pool)
     expected = int(np.sum(np.concatenate(list(per_dp.values()))))
     sqs = queries(cl)
+    sq_diffp = diffp_query(cl)
     srv = SurveyServer(cl, max_batch=3, pipeline=True)
 
     B.TRACE_HOOK = hook
@@ -84,13 +108,20 @@ def main():
               file=sys.stderr)
         srv.prewarm(sqs[0])
         admissions = {sq.survey_id: srv.submit(sq) for sq in sqs}
-        print(f"[{time.time()-t0:6.1f}s] draining 3 surveys "
+        # the diffp survey lands LAST with an empty pool: the refill lane
+        # deposits its slabs while the verify worker grinds the batch
+        admissions["s3"] = srv.submit(sq_diffp)
+        precompute_before = dro.PRECOMPUTE_CALLS
+        print(f"[{time.time()-t0:6.1f}s] draining 4 surveys "
               f"(lanes: {[a.lane for a in admissions.values()]})",
               file=sys.stderr)
         results = srv.drain()
     finally:
         B.TRACE_HOOK = None
     batched_wall = time.time() - t0
+    # the refill lane paid every precompute; the survey itself paid none
+    refill_spans = srv.timers.spans("Refill.")
+    pool_precomputes = dro.PRECOMPUTE_CALLS - precompute_before
 
     batched = {sid: transcript_digest(cl.vns, sid)
                for sid in ("s0", "s1", "s2")}
@@ -108,6 +139,8 @@ def main():
 
     off_main = sorted({(op, t) for op, t in events if t != "MainThread"})
     overlap = pipeline_overlap(srv.timers)
+    r_overlap = refill_overlap(srv.timers)
+    pool_stats = pool.stats()
     summary = {
         "surveys": {
             sid: {
@@ -121,6 +154,25 @@ def main():
                 "serial_transcript_sha256": serial[sid],
                 "byte_identical_to_serial": batched[sid] == serial[sid],
             } for sid in ("s0", "s1", "s2")
+        },
+        "diffp_survey": {
+            "lane": admissions["s3"].lane,
+            "dro_need": admissions["s3"].dro_need,
+            "result": results["s3"].result,
+            "expected": expected,
+            "noise_bound": 4,
+            "within_noise_bound": abs(results["s3"].result - expected) <= 4,
+            "fresh_precomputes_outside_refill":
+                pool_precomputes - srv.refill_slabs,
+        },
+        "pool": {
+            "balance_after": pool_stats["elements_live"],
+            "slabs_consumed": pool_stats["consumed"],
+            "elements_consumed": pool_stats["elements_consumed"],
+            "slabs_refilled": srv.refill_slabs,
+            "refill_lane_s": round(sum(t1 - a for _, a, t1
+                                       in refill_spans), 4),
+            "refill_overlap_s": round(r_overlap, 4),
         },
         "batched_wall_s": round(batched_wall, 2),
         "pipeline_overlap_s": round(overlap, 4),
@@ -137,6 +189,12 @@ def main():
               for s in summary["surveys"].values())
           and summary["surveys"]["s2"]["lane"] == "compile"
           and summary["surveys"]["s0"]["lane"] == "fast"
+          and summary["diffp_survey"]["lane"] == "refill"
+          and summary["diffp_survey"]["within_noise_bound"]
+          and summary["diffp_survey"]["fresh_precomputes_outside_refill"]
+          == 0
+          and summary["pool"]["elements_consumed"]
+          == admissions["s3"].dro_need
           and overlap > 0.0
           and not off_main)
     print(f"[{time.time()-t0:6.1f}s] "

@@ -1,0 +1,354 @@
+"""Crypto-pool integrity (drynx_tpu/pool): single consumption across
+threads AND process restarts, crash recovery, decrypt-correctness of
+pooled DRO, persistent sig tables, and the server refill lane.
+
+The single-consumption property is load-bearing PRIVACY, not hygiene:
+reusing one DRO re-randomization mask across two surveys lets a proof
+observer cancel the masks and recover both secret permutations — so a
+slab handed out twice must raise, whatever the interleaving.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from drynx_tpu import pool as pool_mod  # noqa: E402
+from drynx_tpu.crypto import elgamal as eg  # noqa: E402
+from drynx_tpu.parallel import dro  # noqa: E402
+from drynx_tpu.pool import replenish  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_global_pool():
+    """Each test controls its own active pool; never leak one."""
+    pool_mod.activate(None)
+    yield
+    pool_mod.activate(None)
+
+
+@pytest.fixture
+def keypair():
+    rng = np.random.default_rng(42)
+    x, pub = eg.keygen(rng)
+    return x, pub, eg.pub_table(pub)
+
+
+def _fill(pool, tbl, n_slabs, seed=0):
+    k = jax.random.PRNGKey(seed)
+    sids = []
+    for _ in range(n_slabs):
+        k, s = jax.random.split(k)
+        sids.append(replenish.refill_slab(pool, s, tbl.table))
+    return sids
+
+
+# ---------------------------------------------------------------------------
+# store mechanics
+# ---------------------------------------------------------------------------
+
+def test_deposit_consume_roundtrip(tmp_path, keypair):
+    _, _, tbl = keypair
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=8)
+    dig = pool_mod.key_digest(tbl.table)
+    _fill(pool, tbl, 3)
+    assert pool.dro_balance(dig) == 24
+    z, r = pool.consume_dro(dig, 10)
+    # exact trim; remaining tail of the second slab is discarded with it
+    assert z.shape == (10, 2, 3, 16) and r.shape == (10, 16)
+    assert pool.dro_balance(dig) == 8
+    assert pool.counters["consumed"] == 2
+    # short pool: try_* declines, consume_* raises typed
+    assert pool.try_consume_dro(dig, 9) is None
+    with pytest.raises(pool_mod.InsufficientBalance):
+        pool.consume_dro(dig, 9)
+
+
+def test_consume_under_wrong_key_digest_finds_nothing(tmp_path, keypair):
+    """Slabs are content-addressed by the collective-key table: a pool
+    warm for key A has zero balance for key B (serving cross-key slabs
+    would silently corrupt the re-randomization)."""
+    _, _, tbl = keypair
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=8)
+    _fill(pool, tbl, 1)
+    x2, pub2 = eg.keygen(np.random.default_rng(43))
+    other = pool_mod.key_digest(eg.pub_table(pub2).table)
+    assert pool.dro_balance(other) == 0
+    assert pool.try_consume_dro(other, 1) is None
+
+
+def test_double_consumption_across_threads(tmp_path, keypair):
+    _, _, tbl = keypair
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    dig = pool_mod.key_digest(tbl.table)
+    (sid,) = _fill(pool, tbl, 1)
+
+    wins, raises = [], []
+    barrier = threading.Barrier(8)
+
+    def claim():
+        barrier.wait()
+        try:
+            pool.consume_slab(dig, sid)
+            wins.append(1)
+        except pool_mod.DoubleConsumption:
+            raises.append(1)
+
+    ts = [threading.Thread(target=claim) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1 and len(raises) == 7
+
+
+def test_double_consumption_across_restart(tmp_path, keypair):
+    _, _, tbl = keypair
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    dig = pool_mod.key_digest(tbl.table)
+    (sid,) = _fill(pool, tbl, 1)
+    pool.consume_slab(dig, sid)
+    # "restart": a fresh store over the same root replays the ledger
+    pool2 = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    with pytest.raises(pool_mod.DoubleConsumption):
+        pool2.consume_slab(dig, sid)
+    assert pool2.dro_balance(dig) == 0
+
+
+def test_crash_recovery_discards_partials_and_claimed(tmp_path, keypair):
+    """A writer killed mid-segment leaves a *.tmp; a consumer killed
+    between tombstone and release leaves a *.claimed. Reopen discards
+    both — the claimed slab's randomness was tombstoned, so it must
+    never re-enter the pool — and the ledger stays consistent."""
+    _, _, tbl = keypair
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    dig = pool_mod.key_digest(tbl.table)
+    _fill(pool, tbl, 2)
+    live = pool._live_slabs(dig)
+    assert len(live) == 2
+    # crashed writer: partial segment under the atomic-write tmp name
+    slab_dir = os.path.dirname(live[0])
+    with open(os.path.join(slab_dir, "slab_deadbeef.npz.tmp"), "wb") as f:
+        f.write(b"partial garbage")
+    # crashed consumer: claimed (tombstoned) but never unlinked
+    os.rename(live[0], live[0] + ".claimed")
+    claimed_sid = os.path.basename(live[0])[len("slab_"):-len(".npz")]
+
+    pool2 = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    assert pool2.dro_balance(dig) == 4          # only the intact slab
+    assert pool2.counters["recovered"] == 1
+    assert not any(p.endswith((".tmp", ".claimed"))
+                   for p in _walk(str(tmp_path)))
+    # the recovered slab is tombstoned forever, even after ANOTHER reopen
+    pool3 = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    with pytest.raises(pool_mod.DoubleConsumption):
+        pool3.consume_slab(dig, claimed_sid)
+
+
+def _walk(root):
+    for d, _, fs in os.walk(root):
+        for f in fs:
+            yield os.path.join(d, f)
+
+
+def test_ledger_survives_torn_tail(tmp_path, keypair):
+    """A crash mid-append leaves a torn final JSON line; replay must drop
+    it without losing the earlier events."""
+    _, _, tbl = keypair
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    dig = pool_mod.key_digest(tbl.table)
+    (sid,) = _fill(pool, tbl, 1)
+    pool.consume_slab(dig, sid)
+    with open(pool._ledger_path, "a", encoding="utf-8") as f:
+        f.write('{"ev": "cons')        # torn
+    pool2 = pool_mod.CryptoPool(str(tmp_path), slab_elems=4)
+    with pytest.raises(pool_mod.DoubleConsumption):
+        pool2.consume_slab(dig, sid)
+
+
+# ---------------------------------------------------------------------------
+# DRO correctness with pooled randomness
+# ---------------------------------------------------------------------------
+
+def test_pooled_shuffle_decrypts_like_fresh(tmp_path, keypair):
+    """Pooled and fresh-randomness DRO produce DIFFERENT ciphertexts
+    (different blinding scalars) but the SAME permutation (drawn from the
+    pipeline key, independent of precomp) and the same plaintexts —
+    zero-encryptions add zero whatever their r."""
+    x, pub, tbl = keypair
+    S = 8
+    noise = np.array([0, 1, -1, 2, -2, 0, 1, -1], dtype=np.int64)
+    k_enc, k_sh, k_pool = jax.random.split(jax.random.PRNGKey(3), 3)
+    cts = dro.encrypt_noise(k_enc, tbl, noise)
+
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=S)
+    replenish.refill_slab(pool, k_pool, tbl.table)
+    got = pool.consume_dro(pool_mod.key_digest(tbl.table), S)
+    pooled = (jnp.asarray(got[0]), jnp.asarray(got[1]))
+
+    out_pool, perm_pool, _ = dro.shuffle_rerandomize(
+        k_sh, cts, tbl.table, precomp=pooled)
+    out_fresh, perm_fresh, _ = dro.shuffle_rerandomize(
+        k_sh, cts, tbl.table)
+    assert np.array_equal(np.asarray(perm_pool), np.asarray(perm_fresh))
+
+    dl = eg.DecryptionTable(limit=8)
+    vp, fp = eg.decrypt_ints(out_pool, x, dl)
+    vf, ff = eg.decrypt_ints(out_fresh, x, dl)
+    assert bool(np.all(np.asarray(fp))) and bool(np.all(np.asarray(ff)))
+    assert np.array_equal(np.asarray(vp), np.asarray(vf))
+    assert np.array_equal(np.sort(np.asarray(vp)), np.sort(noise))
+
+
+def test_dro_pipeline_pool_skips_precompute(tmp_path, keypair):
+    x, _, tbl = keypair
+    S, n_servers = 8, 2
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=S)
+    replenish.refill_to(pool, jax.random.PRNGKey(9), tbl.table,
+                        S * n_servers)
+    before = dro.PRECOMPUTE_CALLS
+    cts, noise = dro.dro_pipeline(jax.random.PRNGKey(4), tbl, S, 0.0, 2.0,
+                                  1.0, scale=1.0, limit=4.0,
+                                  n_servers=n_servers, pool=pool)
+    assert dro.PRECOMPUTE_CALLS == before      # warm pool: zero builds
+    assert pool.counters["elements_consumed"] == S * n_servers
+    dl = eg.DecryptionTable(limit=8)
+    vals, found = eg.decrypt_ints(cts, x, dl)
+    assert bool(np.all(np.asarray(found)))
+    assert np.array_equal(np.sort(np.asarray(vals)), np.sort(noise))
+
+
+# ---------------------------------------------------------------------------
+# persistent sig-table store (restart skips builder invocations)
+# ---------------------------------------------------------------------------
+
+def test_sig_store_restart_skips_builds(tmp_path):
+    from drynx_tpu.proofs import range_proof as rproof
+
+    pool = pool_mod.CryptoPool(str(tmp_path))
+    pool_mod.activate(pool)
+    sigs = [rproof.init_range_sig(2, np.random.default_rng(7))]
+
+    rproof.prewarm_sig_tables(sigs, pow_tables=True)
+    gt0 = np.asarray(rproof.sig_gt_table(sigs))
+    pow0 = np.asarray(rproof.sig_gt_pow_tables(sigs))
+    builds = dict(rproof.SIG_BUILD_COUNTS)
+    assert builds["gt_table"] >= 1 and builds["pow_table"] >= 1
+
+    # simulated restart: same signatures rebuilt from the same rng seed,
+    # every in-process cache dropped — only the disk store remains
+    sigs2 = [rproof.init_range_sig(2, np.random.default_rng(7))]
+    assert np.array_equal(sigs2[0].A, sigs[0].A)
+    rproof._GT_TABLE_CACHE.clear()
+    rproof._GT_POW_TABLE_CACHE.clear()
+    rproof._GT_POW_TABLE_DEV.clear()
+
+    rproof.prewarm_sig_tables(sigs2, pow_tables=True)
+    gt1 = np.asarray(rproof.sig_gt_table(sigs2))
+    pow1 = np.asarray(rproof.sig_gt_pow_tables(sigs2))
+    assert dict(rproof.SIG_BUILD_COUNTS) == builds   # zero new builds
+    assert np.array_equal(gt0, gt1)
+    assert np.array_equal(pow0, pow1)
+
+
+def test_fb_table_restart_skips_host_build(tmp_path, keypair):
+    """Fixed-base tables persist through the fb tenant: a fresh store
+    instance on the same root serves the table without paying the host
+    EC ladder build (FB_BUILD_COUNT flat), bytes identical."""
+    _, pub, _ = keypair
+    pool_mod.activate(pool_mod.CryptoPool(str(tmp_path)))
+    t0 = eg.pub_table(pub)
+    builds = eg.FB_BUILD_COUNT
+    pool_mod.activate(pool_mod.CryptoPool(str(tmp_path)))   # restart
+    t1 = eg.pub_table(pub)
+    assert eg.FB_BUILD_COUNT == builds
+    assert np.array_equal(np.asarray(t0.table), np.asarray(t1.table))
+
+
+# ---------------------------------------------------------------------------
+# service + server integration
+# ---------------------------------------------------------------------------
+
+def _diffp():
+    from drynx_tpu.service.query import DiffPParams
+
+    return DiffPParams(noise_list_size=8, lap_mean=0.0, lap_scale=2.0,
+                       quanta=1.0, scale=1.0, limit=4.0)
+
+
+def test_survey_consumes_pool_and_restart_skips_precompute(tmp_path):
+    """ISSUE-9 acceptance: a fresh process with a warm pool skips ALL
+    pool precompute — builder invocations stay flat across a simulated
+    restart (fresh LocalCluster, same roster seed, same disk pool)."""
+    from drynx_tpu.service.service import LocalCluster
+
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=8)
+    cl1 = LocalCluster(n_cns=2, n_dps=2, n_vns=0, seed=19,
+                       dlog_limit=2000, pool=pool)
+    replenish.refill_to(pool, jax.random.PRNGKey(11),
+                        cl1.coll_tbl.table, 8 * 2 * 2)
+    dig = pool_mod.key_digest(cl1.coll_tbl.table)
+
+    def run(cl):
+        for dp in cl.dps.values():
+            dp.data = np.arange(4, dtype=np.int64)
+        sq = cl.generate_survey_query("sum", query_min=0, query_max=5,
+                                      diffp=_diffp())
+        return cl.run_survey(sq)
+
+    before = dro.PRECOMPUTE_CALLS
+    res = run(cl1)
+    assert dro.PRECOMPUTE_CALLS == before        # pooled, no fresh builds
+    assert abs(res.result - 12) <= 4
+    assert pool.counters["elements_consumed"] == 8 * 2
+
+    # restart: fresh cluster + fresh store over the same root
+    pool2 = pool_mod.CryptoPool(str(tmp_path), slab_elems=8)
+    cl2 = LocalCluster(n_cns=2, n_dps=2, n_vns=0, seed=19,
+                       dlog_limit=2000, pool=pool2)
+    assert pool2.dro_balance(dig) == 16
+    before = dro.PRECOMPUTE_CALLS
+    res2 = run(cl2)
+    assert dro.PRECOMPUTE_CALLS == before
+    assert abs(res2.result - 12) <= 4
+
+
+def test_server_refill_lane(tmp_path):
+    """An empty pool routes a diffp survey to the refill lane; the drain
+    thread deposits slabs cooperatively until the balance covers the
+    noise need, then the survey runs pooled (zero fresh precompute)."""
+    from drynx_tpu.server import SurveyServer
+    from drynx_tpu.service.service import LocalCluster
+
+    pool = pool_mod.CryptoPool(str(tmp_path), slab_elems=8)
+    cl = LocalCluster(n_cns=2, n_dps=2, n_vns=0, seed=23,
+                      dlog_limit=2000, pool=pool)
+    for dp in cl.dps.values():
+        dp.data = np.arange(4, dtype=np.int64)
+    srv = SurveyServer(cl, pipeline=False)
+    sq = cl.generate_survey_query("sum", query_min=0, query_max=5,
+                                  diffp=_diffp(), survey_id="s_refill")
+    a = srv.submit(sq)
+    assert a.lane == "refill" and a.dro_need == 16
+    before = dro.PRECOMPUTE_CALLS
+    results = srv.drain()
+    res = results["s_refill"]
+    assert not isinstance(res, Exception), res
+    assert abs(res.result - 12) <= 4
+    # refill deposited exactly the need (2 slabs of 8), all consumed
+    assert srv.refill_slabs == 2
+    # the refill lane paid the precompute (2 slabs), the survey itself
+    # paid none beyond it
+    assert dro.PRECOMPUTE_CALLS == before + 2
+    assert pool.counters["elements_consumed"] == 16
+    # warm pool now: a second identical survey goes straight to fast
+    replenish.refill_to(pool, jax.random.PRNGKey(29),
+                        cl.coll_tbl.table, 16)
+    sq2 = cl.generate_survey_query("sum", query_min=0, query_max=5,
+                                   diffp=_diffp(), survey_id="s_fast")
+    assert srv.submit(sq2).lane == "fast"
+    results = srv.drain()
+    assert not isinstance(results["s_fast"], Exception)

@@ -47,7 +47,7 @@ def test_registry_names_unique_and_thunks_wellformed(registry):
     for s in registry:
         assert callable(s.lower) and callable(s.dispatched), s.name
         assert s.call is None or callable(s.call), s.name
-        assert s.kind in ("bucketed", "pallas", "fused"), s.name
+        assert s.kind in ("bucketed", "pallas", "fused", "pool"), s.name
 
 
 def test_registry_scales_with_profile():
@@ -216,3 +216,49 @@ def test_cli_list_buckets_includes_tile_programs(capsys):
     assert cli.main(["--list"]) == 0
     out = capsys.readouterr().out
     assert "RangeProofCreateTile" not in out
+
+
+def test_registry_pool_program_set():
+    """Profile.n_noise > 0 must add the DRO pool/slab programs (the raw
+    jits the precompute refill + shuffle paths dispatch) at exactly the
+    dro.slab_widths chunk widths plus the monolithic width — and must
+    only ever ADD programs: the non-diffp registry stays a strict subset,
+    so pooling can never silently drop AOT coverage."""
+    from drynx_tpu.parallel import dro
+
+    base = cc.BENCH
+    pooled = cc.build_registry(dataclasses_replace(base, n_noise=10000))
+    base_names = {s.name for s in cc.build_registry(base)}
+    pooled_names = {s.name for s in pooled}
+    assert base_names <= pooled_names
+    extra = [s for s in pooled if s.name not in base_names]
+    assert extra, "n_noise must add pool programs"
+    assert {s.phase for s in extra} == {"DROPool"}
+    assert {s.kind for s in extra} == {"pool"}
+    # every slab width the chunked path dispatches is certified
+    widths = set(dro.slab_widths(10000)) | {10000}
+    for op in ("encrypt_with_tables", "int_to_scalar", "ct_add"):
+        got = {int(s.name.rsplit("@", 1)[1]) for s in extra if s.op == op}
+        assert got == widths, (op, got, widths)
+    # pool programs always dispatch (plain device jits, no backend gate)
+    assert all(s.dispatched() for s in extra)
+
+
+def test_registry_n_noise_zero_is_identity():
+    base = cc.BENCH
+    zero = cc.build_registry(dataclasses_replace(base, n_noise=0))
+    assert {s.name for s in zero} == {s.name
+                                      for s in cc.build_registry(base)}
+
+
+def test_cli_list_noise_includes_pool_programs(capsys):
+    from drynx_tpu import precompile as cli
+
+    assert cli.main(["--list", "--noise", "10000"]) == 0
+    out = capsys.readouterr().out
+    assert "pool:encrypt_with_tables@4096" in out
+    assert "DROPool" in out
+    # no diffp axis -> no pool programs
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "DROPool" not in out

@@ -10,9 +10,9 @@ analysis package deliberately imports no jax, so this test stays alive
 even when the accelerator stack is broken.
 """
 import json
+import os
 import subprocess
 import sys
-import time
 
 import pytest
 
@@ -95,11 +95,26 @@ def test_cli_passes_a_clean_file(tmp_path):
 def test_project_pass_is_clean_and_fast():
     # the acceptance budget: import graph + callgraph + all three project
     # rules over the whole package, under five seconds, zero findings.
-    t0 = time.monotonic()
-    findings = analyze_project([PACKAGE])
-    elapsed = time.monotonic() - t0
-    assert findings == [], "\n".join(f.render() for f in findings)
-    assert elapsed < 5.0, f"project pass took {elapsed:.1f}s (budget 5s)"
+    # Measured in a fresh interpreter — the way the pass actually runs
+    # (check.sh lint tiers, the CLI): inside a long pytest session the
+    # accumulated heap roughly doubles the in-process wall time, which
+    # says nothing about the pass itself.
+    prog = (
+        "import json, sys, time\n"
+        "from drynx_tpu.analysis.project import analyze_project\n"
+        "t0 = time.monotonic()\n"
+        "findings = analyze_project([%r])\n"
+        "json.dump({'elapsed': time.monotonic() - t0,\n"
+        "           'findings': [f.render() for f in findings]}, sys.stdout)\n"
+        % str(PACKAGE))
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run([sys.executable, "-c", prog], cwd=str(REPO_ROOT),
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["findings"] == [], "\n".join(out["findings"])
+    assert out["elapsed"] < 5.0, \
+        f"project pass took {out['elapsed']:.1f}s (budget 5s)"
 
 
 def test_list_rules_marks_project_rules():
