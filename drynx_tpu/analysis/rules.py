@@ -452,10 +452,18 @@ class HardcodedTimeout(Rule):
     in drynx_tpu/resilience/policy.py — that module is the single place
     the rule exempts. Fires on: timeout=/retries= keyword literals,
     timeout-ish parameter defaults, sleep/wait calls with literal
-    durations, and `.get("...timeout...", <literal>)` fallbacks."""
+    durations, and `.get("...timeout...", <literal>)` fallbacks.
+
+    The network plane (PR 10) added a second family of tuning knobs with
+    the same auditability problem: fan-out worker counts and connection-
+    pool bounds (workers=/max_workers=/max_idle=/pool_size=). A bare
+    ``max_workers=8`` decides how hard a survey hammers a roster exactly
+    like a bare ``timeout=900`` decides how long it stalls — both live as
+    named constants in resilience/policy.py (FAN_OUT_WORKERS,
+    CONN_POOL_MAX_IDLE)."""
 
     id = "hardcoded-timeout"
-    summary = ("bare numeric timeout/retry literal outside "
+    summary = ("bare numeric timeout/retry/worker-pool literal outside "
                "drynx_tpu/resilience/ — name it in resilience/policy.py")
 
     _SLEEPY = {"sleep", "wait", "join"}
@@ -464,7 +472,10 @@ class HardcodedTimeout(Rule):
     def _timeoutish(name: str) -> bool:
         n = name.lower()
         return ("timeout" in n or n == "retries" or n.endswith("_retries")
-                or n.endswith("deadline"))
+                or n.endswith("deadline")
+                or n == "workers" or n.endswith("_workers")
+                or n == "max_idle" or n.endswith("_idle")
+                or n == "pool_size" or n.endswith("_pool_size"))
 
     @staticmethod
     def _nonzero_num(node: ast.AST) -> bool:

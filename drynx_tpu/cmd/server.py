@@ -51,11 +51,16 @@ def cmd_run(args) -> int:
         data = lr.load_csv(args.lr_data)
     elif args.data:
         data = np.loadtxt(args.data, dtype=np.int64, ndmin=1)
+    pool = None
+    if args.pool:
+        from ..pool import CryptoPool
+
+        pool = CryptoPool(args.pool)
     node = DrynxNode(cfg["name"], int(cfg["secret"], 16),
                      (int(cfg["public_x"], 16), int(cfg["public_y"], 16)),
                      host=cfg.get("host", "127.0.0.1"),
                      port=int(cfg.get("port", 0)), data=data,
-                     db_path=args.db)
+                     db_path=args.db, pool=pool)
     print(f"drynx node {cfg['name']} listening on "
           f"{node.address[0]}:{node.address[1]}", file=sys.stderr, flush=True)
     try:
@@ -83,6 +88,11 @@ def main(argv=None) -> int:
                         "(label in column 0)")
     r.add_argument("--db", default=None,
                    help="proof/skipchain DB path (VN role)")
+    r.add_argument("--pool", default=None,
+                   help="crypto-pool directory (CryptoPool): DRO slabs "
+                        "for shuffle contributions + persisted sig/fb "
+                        "tables warm-start this process. $DRYNX_POOL_DIR "
+                        "is the env equivalent.")
     r.set_defaults(fn=cmd_run)
     args = p.parse_args(argv)
     return args.fn(args)

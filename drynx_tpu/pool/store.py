@@ -92,8 +92,15 @@ def _fsync_dir(path: str) -> None:
 
 def _atomic_write_npz(path: str, **arrays) -> None:
     """tmp + fsync + os.replace: a reader never observes a partial file
-    under the final name; a crash leaves only a ``*.tmp`` to sweep."""
-    tmp = f"{path}.tmp"
+    under the final name; a crash leaves only a ``*.tmp`` to sweep.
+
+    The tmp name is unique per writer: content-addressed artifacts (fb /
+    sig tables) are warmed concurrently by server threads since the
+    fan-out went parallel, and two writers sharing one tmp path race —
+    the loser's os.replace finds the tmp already moved (observed as
+    FileNotFoundError under the DP dispatch fan-out). Distinct tmps make
+    concurrent same-digest writes last-writer-wins over identical bytes."""
+    tmp = f"{path}.{secrets.token_hex(8)}.tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
         f.flush()

@@ -13,13 +13,21 @@ points — the same module-global pattern as its ``LinkModel``:
                                                             mid-answer")
   where="node"     server side, the whole node             (kill, pause)
 
-Determinism: each spec owns an ``np.random.default_rng((plan.seed, i))``
-stream, so whether a probabilistic spec fires depends only on the plan
-seed and the *order* of matching events — which the sequential survey
-dispatch makes reproducible. Node-level verdicts are memoized per
-(spec, node) so "is dp3 dead?" never flips mid-run. Two runs with the
-same plan seed and the same traffic order take identical fault decisions
-(asserted in tests/test_resilience.py).
+Determinism: every draw is keyed, not streamed. A link-level event draws
+from ``np.random.default_rng((seed, spec_idx, name_key(target), seq))``
+where ``seq`` is that (spec, target)'s own invocation counter — so whether
+a probabilistic spec fires depends only on the plan seed, the target node,
+and how many times *that node* hit the hook, never on the global arrival
+order of traffic. The concurrent fan-out (service/node.py) interleaves
+RPCs across worker threads nondeterministically; per-node keying keeps
+the 17 chaos scenarios and the kill-DP soak seed-reproducible anyway.
+``count`` caps are per-(spec, target) for the same reason (a global cap
+would be consumed by whichever thread arrived first); ``spec.fired``
+remains the total across targets. Node-level verdicts are keyed per
+(spec, node) and memoized so "is dp3 dead?" never flips mid-run. Two runs
+with the same plan seed take identical per-node fault decisions whatever
+the traffic interleaving (asserted in tests/test_resilience.py and
+tests/test_net_plane.py).
 
 No transport import here (transport imports *us*); no jax import either —
 like the analysis package, chaos tooling must work when the accelerator
@@ -29,10 +37,18 @@ from __future__ import annotations
 
 import dataclasses
 import fnmatch
+import hashlib
 import threading
 from typing import Optional
 
 import numpy as np
+
+
+def _name_key(name: str) -> int:
+    """Stable 64-bit key for a node name (``hash()`` is salted per
+    process, useless for cross-run determinism)."""
+    return int.from_bytes(
+        hashlib.blake2s(name.encode(), digest_size=8).digest(), "big")
 
 KINDS = ("refuse", "drop", "delay", "close_mid_frame", "corrupt",
          "kill", "pause")
@@ -79,9 +95,10 @@ class FaultPlan:
     def __init__(self, seed: int = 0, specs=()):
         self.seed = int(seed)
         self.specs: list[FaultSpec] = []
-        self._rngs: list[np.random.Generator] = []
         self._killed: set[str] = set()
         self._node_verdicts: dict[tuple[int, str], bool] = {}
+        self._seq: dict[tuple[int, str], int] = {}       # draw counters
+        self._fired_by: dict[tuple[int, str], int] = {}  # per-target caps
         self._lock = threading.Lock()
         for s in specs:
             self.add(s)
@@ -89,8 +106,6 @@ class FaultPlan:
     def add(self, spec: FaultSpec) -> FaultSpec:
         with self._lock:
             self.specs.append(spec)
-            self._rngs.append(
-                np.random.default_rng((self.seed, len(self.specs) - 1)))
         return spec
 
     # -- node-level state ------------------------------------------------
@@ -133,7 +148,9 @@ class FaultPlan:
             key = (i, name)
             if key not in self._node_verdicts:
                 self._node_verdicts[key] = (
-                    s.prob >= 1.0 or float(self._rngs[i].random()) < s.prob)
+                    s.prob >= 1.0
+                    or float(np.random.default_rng(
+                        (self.seed, i, _name_key(name))).random()) < s.prob)
             if self._node_verdicts[key]:
                 return s
         return None
@@ -142,20 +159,28 @@ class FaultPlan:
     def pick(self, where: str, target: str,
              mtype: str = "*") -> Optional[FaultSpec]:
         """First matching link-level spec that fires for this event, with
-        its counter consumed. Every matching probabilistic spec advances
-        its stream exactly once per event, fired or not, so the draw
-        sequence depends only on traffic order."""
+        its counter consumed. Draws are keyed on (plan seed, spec index,
+        target node, that pair's own event counter): the verdict for
+        "dp3's second connect" is the same whether dp3 dialed second or
+        sixth, so concurrent fan-out cannot perturb a seeded schedule."""
         with self._lock:
             for i, s in enumerate(self.specs):
                 if s.where != where or s.where == "node":
                     continue
                 if not s.matches(target, mtype):
                     continue
-                if s.count is not None and s.fired >= s.count:
+                key = (i, target)
+                if (s.count is not None
+                        and self._fired_by.get(key, 0) >= s.count):
                     continue
+                seq = self._seq.get(key, 0)
+                self._seq[key] = seq + 1
                 fires = (s.prob >= 1.0
-                         or float(self._rngs[i].random()) < s.prob)
+                         or float(np.random.default_rng(
+                             (self.seed, i, _name_key(target),
+                              seq)).random()) < s.prob)
                 if fires:
+                    self._fired_by[key] = self._fired_by.get(key, 0) + 1
                     s.fired += 1
                     return s
         return None

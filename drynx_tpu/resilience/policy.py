@@ -60,6 +60,19 @@ END_VERIFICATION_TIMEOUT_S = 600.0
 # Local helper subprocesses (git queries in tooling, never network calls).
 SUBPROCESS_TIMEOUT_S = 30.0
 
+# -- network-plane knobs (PR 10) --------------------------------------------
+# Bounded roster fan-out: how many concurrent RPCs one fan_out() call may
+# have in flight (service/node.py). Sized for control-plane I/O overlap,
+# not compute — handlers run on the PEER's threads; these workers only
+# hold sockets open. DRYNX_FANOUT_WORKERS overrides, DRYNX_FANOUT=serial
+# forces the one-at-a-time legacy dispatch.
+FAN_OUT_WORKERS = 8
+# Connection pool (service/transport.ConnPool): idle sockets kept per
+# roster entry. Beyond this, returned connections are closed instead of
+# pooled — a bounded steady-state fd footprint of
+# len(roster) * CONN_POOL_MAX_IDLE per client process.
+CONN_POOL_MAX_IDLE = 4
+
 # -- idempotency table ------------------------------------------------------
 # Read-only or set-once-overwrite handlers: re-execution is harmless.
 IDEMPOTENT_MTYPES = frozenset({
@@ -126,4 +139,5 @@ __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
            "BACKOFF_JITTER", "CALL_TIMEOUT_S", "PING_TIMEOUT_S",
            "VERIFY_WAIT_S", "PROOF_DRAIN_S", "STRAGGLER_GRACE_S",
            "VN_GROUP_WAIT_S", "POLL_INTERVAL_S", "COLD_COMPILE_WAIT_S",
-           "END_VERIFICATION_TIMEOUT_S", "SUBPROCESS_TIMEOUT_S"]
+           "END_VERIFICATION_TIMEOUT_S", "SUBPROCESS_TIMEOUT_S",
+           "FAN_OUT_WORKERS", "CONN_POOL_MAX_IDLE"]

@@ -29,11 +29,13 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import math
+import os
 import pickle
 import secrets
 import threading
 import time
-from typing import Optional
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -53,13 +55,25 @@ from ..proofs import range_proof as rproof
 from ..proofs import requests as rq
 from ..proofs import schnorr
 from ..proofs import shuffle as shuffle_proof
+from ..pool import store as pool_store
+from .. import pool as pool_mod
 from ..proofs.safe_pickle import safe_loads
 from ..resilience import policy as rp
 from ..utils import log
 from .proof_collection import VerifyingNode
 from .skipchain import DataBlock
 from .transport import (ConnectError, Conn, NodeServer, RemoteError,
-                        TransportError, pack_array, unpack_array)
+                        TransportError, conn_pool, link_model,
+                        pack_array, unpack_array)
+
+
+def _net_delta(before: dict, after: dict) -> dict:
+    """LinkModel stats delta over one survey (process-global counters)."""
+    peers = {k: v - before["by_peer"].get(k, 0)
+             for k, v in after["by_peer"].items()}
+    return {"bytes_total": after["bytes_total"] - before["bytes_total"],
+            "msgs_total": after["msgs_total"] - before["msgs_total"],
+            "by_peer": {k: v for k, v in peers.items() if v}}
 
 
 def _pack_bytes(b: bytes) -> dict:
@@ -84,24 +98,43 @@ def call_entry(entry, msg: dict, retries: Optional[int] = None,
     have been written, the failure surfaces immediately — a re-send could
     re-execute the handler. A RemoteError always surfaces: the handler
     ran, so the transport did its job. ``retries``/``timeout`` override
-    the corresponding policy fields for this one call."""
+    the corresponding policy fields for this one call.
+
+    Connections come from the process ConnPool when one is active
+    (DRYNX_CONN_POOL=off disables): checked out per call, returned on
+    success — RemoteError included, the handler ran so the framing is
+    intact — and discarded after any transport failure, so a broken or
+    half-read socket can never serve a later call."""
     pol = policy or rp.DEFAULT_POLICY
     if retries is not None:
         pol = dataclasses.replace(pol, connect_retries=int(retries))
     if timeout is not None:
         pol = dataclasses.replace(pol, call_timeout_s=float(timeout))
     mtype = msg.get("type", "")
+    pool = conn_pool()
     attempt = 0
     while True:
         conn = None
         try:
-            conn = Conn(entry.host, entry.port,
-                        timeout=pol.call_timeout_s, peer=entry.name)
-            return conn.call(msg)
+            if pool is not None:
+                conn = pool.get(entry.host, entry.port,
+                                timeout=pol.call_timeout_s, peer=entry.name)
+            else:
+                conn = Conn(entry.host, entry.port,
+                            timeout=pol.call_timeout_s, peer=entry.name)
+            reply = conn.call(msg)
         except RemoteError:
+            if pool is not None:
+                pool.put(conn)
+            elif conn is not None:
+                conn.close()
             raise
         except (TransportError, OSError) as e:
             sent = conn.sent if conn is not None else False
+            if pool is not None:
+                pool.discard(conn)
+            elif conn is not None:
+                conn.close()
             attempt += 1
             if attempt >= pol.attempts_for(mtype, sent):
                 if sent:
@@ -110,9 +143,70 @@ def call_entry(entry, msg: dict, retries: Optional[int] = None,
                     f"node {entry.name} at {entry.host}:{entry.port} "
                     f"unreachable after {attempt} attempts: {e!r}") from e
             time.sleep(pol.backoff(attempt - 1))
-        finally:
-            if conn is not None:
+        else:
+            if pool is not None:
+                pool.put(conn)
+            else:
                 conn.close()
+            return reply
+
+
+def _fan_out_workers() -> int:
+    """DRYNX_FANOUT=serial forces one-at-a-time dispatch;
+    DRYNX_FANOUT_WORKERS overrides the pool width (rp.FAN_OUT_WORKERS)."""
+    if os.environ.get("DRYNX_FANOUT", "").strip().lower() == "serial":
+        return 1
+    w = os.environ.get("DRYNX_FANOUT_WORKERS", "").strip()
+    if w:
+        return int(w)
+    return rp.FAN_OUT_WORKERS
+
+
+def fan_out(entries, make_msg: Callable, call: Callable = None,
+            policy: Optional[rp.RetryPolicy] = None,
+            workers: Optional[int] = None) -> list:
+    """One RPC per roster entry on a bounded worker pool.
+
+    The shared dispatch primitive for every star-topology round (range-sig
+    collection, DP dispatch, VN broadcasts, key-switch contributions,
+    liveness probes): remote wall-clock becomes max-over-nodes instead of
+    sum-over-nodes, while each call keeps its own RetryPolicy semantics
+    via ``call_entry``.
+
+    Messages are built upfront on the CALLER's thread (``make_msg(entry)``
+    may touch non-thread-safe state), and the return value is
+    ``[(reply, None) | (None, exc)]`` aligned with roster order — callers
+    iterate ``zip(entries, results)`` and re-raise/aggregate in roster
+    order, which keeps transcripts and sums byte-identical to the old
+    serial loops whatever the completion interleaving. ``call`` defaults
+    to ``call_entry`` under ``policy``; pass a custom callable to reuse
+    the pool for loopback or raw-socket dispatch.
+    """
+    entries = list(entries)
+    if call is None:
+        def call(e, m):
+            return call_entry(e, m, policy=policy)
+    msgs = [make_msg(e) for e in entries]
+    n = _fan_out_workers() if workers is None else int(workers)
+    n = max(1, min(n, len(entries)))
+    results: list = [None] * len(entries)
+    if n <= 1:
+        for i, (e, m) in enumerate(zip(entries, msgs)):
+            try:
+                results[i] = (call(e, m), None)
+            except Exception as err:
+                results[i] = (None, err)
+        return results
+    with ThreadPoolExecutor(max_workers=n) as ex:
+        futs = {ex.submit(call, e, m): i
+                for i, (e, m) in enumerate(zip(entries, msgs))}
+        for f in as_completed(futs):
+            i = futs[f]
+            try:
+                results[i] = (f.result(), None)
+            except Exception as err:
+                results[i] = (None, err)
+    return results
 
 
 @dataclasses.dataclass
@@ -153,11 +247,19 @@ class DrynxNode:
                  host: str = "127.0.0.1", port: int = 0,
                  data: Optional[np.ndarray] = None,
                  db_path: Optional[str] = None,
-                 policy: Optional[rp.RetryPolicy] = None):
+                 policy: Optional[rp.RetryPolicy] = None,
+                 pool: Optional[pool_store.CryptoPool] = None):
         self.name = name
         self.secret = secret
         self.public = public
         self.data = data
+        # Activate the crypto pool BEFORE any table build so the sig/fb
+        # tenants warm-start this process and shuffle contributions can
+        # consume DRO slabs (ROADMAP item 5's remaining gap: remote CNs
+        # used to precompute locally). $DRYNX_POOL_DIR covers processes
+        # that don't pass one explicitly (pool_mod.active_pool()).
+        if pool is not None:
+            pool_mod.activate(pool)
         # all of this node's OUTBOUND calls (DP dispatch, proof delivery,
         # VN polling) run under one RetryPolicy; tests inject short
         # timeouts here instead of monkeypatching call sites
@@ -286,13 +388,12 @@ class DrynxNode:
                      "differ_info": differ, "round_id": 0,
                      "data": _pack_bytes(req.data),
                      "signature": _pack_bytes(req.signature.to_bytes())}
-            for e in vns:
-                try:
-                    call_entry(e, frame, policy=self.policy)
-                except Exception as err:
+            outs = fan_out(vns, lambda e: dict(frame), policy=self.policy)
+            for e, (_r, err) in zip(vns, outs):
+                if err is not None:
                     # an unreachable/erroring VN simply never counts this
                     # proof; the end_verification counter gate reports the
-                    # shortfall. Keep delivering to the REMAINING VNs.
+                    # shortfall. The REMAINING VNs were still delivered to.
                     log.warn(f"{self.name}: {ptype} proof undeliverable to "
                              f"VN {e.name}: {err}")
 
@@ -451,7 +552,28 @@ class DrynxNode:
         coll_pub = self.roster.collective_pub()
         tbl = self._pub_table(coll_pub)
         key = jax.random.PRNGKey(secrets.randbits(63))
-        out_cts, perm, rs = dro.shuffle_rerandomize(key, cts, tbl.table)
+        # Consume pooled DRO precompute when the active pool covers this
+        # collective key: the fixed-base pass (the dominant cost) is
+        # skipped and the slab's single-consumption claim guarantees the
+        # randomness is never served twice, even across CN processes
+        # sharing one pool directory.
+        precomp = None
+        cpool = pool_mod.active_pool()
+        if cpool is not None:
+            got = cpool.try_consume_dro(pool_store.key_digest(tbl.table),
+                                        int(cts.shape[0]))
+            if got is not None:
+                precomp = (jnp.asarray(got[0]), jnp.asarray(got[1]))
+        if precomp is None:
+            # cold path: pay the fixed-base pass here, through the COUNTED
+            # builder (dro.PRECOMPUTE_CALLS) so pooled-vs-fresh serving is
+            # observable per process — the bench and tests assert the
+            # counter stays flat when slabs covered the need
+            k_pre, key = jax.random.split(key)
+            precomp = dro.precompute_rerandomization(k_pre, tbl.table,
+                                                     int(cts.shape[0]))
+        out_cts, perm, rs = dro.shuffle_rerandomize(key, cts, tbl.table,
+                                                    precomp=precomp)
         if msg.get("proofs"):
             from ..crypto.params import from_limbs
 
@@ -528,9 +650,13 @@ class DrynxNode:
         range_sigs_msg: dict = {}
         if proofs and ranges_v:
             for (u, _l) in rproof.group_ranges(ranges_v):
+                outs = fan_out(cns,
+                               lambda e, u=u: {"type": "range_sig", "u": u},
+                               call=self._call_cn)
                 pubs, As = [], []
-                for e in cns:
-                    r = self._call_cn(e, {"type": "range_sig", "u": u})
+                for e, (r, err) in zip(cns, outs):
+                    if err is not None:
+                        raise err
                     pubs.append([int(t) for t in r["pub"]])
                     As.append(unpack_array(r["A"]))
                 range_sigs_msg[str(u)] = {"pubs": pubs,
@@ -539,31 +665,32 @@ class DrynxNode:
         # collect encrypted DP responses (star topology); DPs fire range
         # proofs at the VNs from their own processes
         range_offset = int(msg.get("range_offset", 0))
+        dp_frame = {"type": "survey_dp", "op": op,
+                    "survey_id": survey_id,
+                    "query_min": msg["query_min"],
+                    "query_max": msg["query_max"],
+                    "lr_params": msg.get("lr_params"),
+                    "group_by": msg.get("group_by"),
+                    "range_offset": range_offset,
+                    "proofs": proofs, "ranges": ranges_v,
+                    "range_sigs": range_sigs_msg}
+        outs = fan_out(dps, lambda e: dict(dp_frame), policy=self.policy)
         cts = []
         responders: list[str] = []
         failed: list[str] = []
-        for e in dps:
-            try:
-                r = call_entry(e, {"type": "survey_dp", "op": op,
-                                   "survey_id": survey_id,
-                                   "query_min": msg["query_min"],
-                                   "query_max": msg["query_max"],
-                                   "lr_params": msg.get("lr_params"),
-                                   "group_by": msg.get("group_by"),
-                                   "range_offset": range_offset,
-                                   "proofs": proofs, "ranges": ranges_v,
-                                   "range_sigs": range_sigs_msg},
-                               policy=self.policy)
-            except RemoteError:
-                raise   # the DP's handler ran and errored: a real bug,
-                        # not an availability fault — don't degrade past it
-            except (TransportError, OSError) as err:
+        for e, (r, err) in zip(dps, outs):
+            if err is None:
+                responders.append(e.name)
+                cts.append(unpack_array(r["cts"]))
+            elif isinstance(err, RemoteError):
+                raise err   # the DP's handler ran and errored: a real bug,
+                            # not an availability fault — don't degrade
+            elif isinstance(err, (TransportError, OSError)):
                 log.warn(f"{self.name}: DP {e.name} unavailable for survey "
                          f"{survey_id}: {err}")
                 failed.append(e.name)
-                continue
-            responders.append(e.name)
-            cts.append(unpack_array(r["cts"]))
+            else:
+                raise err
         if len(responders) < need:
             raise RuntimeError(
                 f"survey {survey_id}: only {len(responders)}/{len(dps)} DPs "
@@ -574,17 +701,19 @@ class DrynxNode:
             # DP; shrink their counters to the responder set or the
             # expected-proof gate never drains (and the joint range flush
             # never triggers)
-            for v in self.roster.of_role("vn"):
-                try:
-                    call_entry(v, {"type": "vn_adjust",
-                                   "survey_id": survey_id,
-                                   "expected_drop": len(failed),
-                                   "expected_range": len(responders),
-                                   "absent": sorted(failed)},
-                               policy=self.policy)
-                except (TransportError, OSError) as err:
+            adj = {"type": "vn_adjust", "survey_id": survey_id,
+                   "expected_drop": len(failed),
+                   "expected_range": len(responders),
+                   "absent": sorted(failed)}
+            vns_all = self.roster.of_role("vn")
+            for v, (_r, err) in zip(vns_all,
+                                    fan_out(vns_all, lambda e: dict(adj),
+                                            policy=self.policy)):
+                if isinstance(err, (TransportError, OSError)):
                     log.warn(f"{self.name}: vn_adjust undeliverable to "
                              f"{v.name}: {err}")
+                elif err is not None:
+                    raise err
         cts = jnp.asarray(np.stack(cts))              # (n_responders, V, 2,3,16)
         agg = B.tree_reduce_add(cts, B.ct_add)
         if proofs:
@@ -592,7 +721,10 @@ class DrynxNode:
                 "aggregation", survey_id, f"agg-{self.name}",
                 pickle.dumps(agg_proof.create_aggregation_proof(cts, agg)))
 
-        # obfuscation chain over the CNs (zero/nonzero-semantics ops)
+        # obfuscation chain over the CNs (zero/nonzero-semantics ops).
+        # This round (and the DRO shuffle below) is a CHAIN, not a star:
+        # each CN consumes the previous CN's output ciphertexts, so the
+        # crypto forces sequential dispatch — fan_out does not apply.
         if msg.get("obfuscation"):
             for e in cns:
                 r = self._call_cn(e, {"type": "obf_contrib",
@@ -623,14 +755,18 @@ class DrynxNode:
             idx = np.arange(V) % int(n_cts.shape[0])
             agg = B.ct_add(agg, jnp.take(n_cts, jnp.asarray(idx), axis=0))
 
-        # key switch: gather contributions from every CN (including self)
+        # key switch: gather contributions from every CN (including self).
+        # A star round — every CN switches the SAME K0 component — so it
+        # fans out; the point sums accumulate in roster order below.
         K0 = np.asarray(agg[:, 0])
+        ks_frame = {"type": "ks_contrib", "k_component": pack_array(K0),
+                    "client_pub": list(msg["client_pub"]),
+                    "survey_id": survey_id, "proofs": proofs}
+        outs = fan_out(cns, lambda e: dict(ks_frame), call=self._call_cn)
         k_sum = c_sum = None
-        for e in cns:
-            r = self._call_cn(e, {"type": "ks_contrib",
-                                  "k_component": pack_array(K0),
-                                  "client_pub": list(msg["client_pub"]),
-                                  "survey_id": survey_id, "proofs": proofs})
+        for e, (r, err) in zip(cns, outs):
+            if err is not None:
+                raise err
             u = jnp.asarray(unpack_array(r["u"]))
             w = jnp.asarray(unpack_array(r["w"]))
             k_sum = u if k_sum is None else B.g1_add(k_sum, u)
@@ -870,25 +1006,36 @@ class RemoteClient:
         # Populated by run_survey when proofs/quorum bookkeeping runs.
         self.last_responders: list[str] = []
         self.last_absent: list[str] = []
+        # Per-survey LinkModel byte accounting (delta over run_survey):
+        # {"bytes_total", "msgs_total", "by_peer"} — zeros with no link
+        # model configured beyond the counters themselves.
+        self.last_net: dict = {}
 
     def broadcast_roster(self) -> dict:
         """Push the roster to every entry. Unreachable nodes are recorded
         as False instead of aborting the whole broadcast — a dead node
         picks the roster up via set_roster when it rejoins, and the
-        probe/quorum survey path tolerates its absence meanwhile."""
-        ok = {}
-        for e in self.roster.entries:
+        probe/quorum survey path tolerates its absence meanwhile.
+        Deliberately unpooled fresh connections (a one-shot bootstrap
+        broadcast, not survey traffic), fanned out concurrently."""
+        def send_one(e, m):
+            c = Conn(e.host, e.port, peer=e.name)
             try:
-                c = Conn(e.host, e.port, peer=e.name)
-                try:
-                    c.call({"type": "set_roster",
-                            "roster": self.roster.to_dict()})
-                    ok[e.name] = True
-                finally:
-                    c.close()
-            except (TransportError, OSError) as err:
+                return c.call(m)
+            finally:
+                c.close()
+
+        msg = {"type": "set_roster", "roster": self.roster.to_dict()}
+        outs = fan_out(self.roster.entries, lambda e: msg, call=send_one)
+        ok = {}
+        for e, (_r, err) in zip(self.roster.entries, outs):
+            if err is None:
+                ok[e.name] = True
+            elif isinstance(err, (TransportError, OSError)):
                 log.warn(f"roster undeliverable to {e.name}: {err!r}")
                 ok[e.name] = False
+            else:
+                raise err
         return ok
 
     def ping(self, entry: RosterEntry) -> bool:
@@ -906,8 +1053,14 @@ class RemoteClient:
             return False
 
     def probe_liveness(self) -> dict[str, bool]:
-        """Ping every roster entry; map node name -> alive."""
-        return {e.name: self.ping(e) for e in self.roster.entries}
+        """Ping every roster entry CONCURRENTLY; map node name -> alive.
+        Dead nodes each burn a connect timeout — fanned out, a roster
+        full of corpses costs one timeout, not one per corpse. This is
+        the re-probe hook survey resume builds on (ROADMAP item 6)."""
+        outs = fan_out(self.roster.entries, lambda e: {"type": "ping"},
+                       call=lambda e, m: self.ping(e))
+        return {e.name: bool(r) for e, (r, _err)
+                in zip(self.roster.entries, outs)}
 
     def expected_proofs(self, n_dps: int, n_cns: int, obfuscation: bool,
                         diffp: bool) -> int:
@@ -946,6 +1099,7 @@ class RemoteClient:
         the real network, data_collection_protocol.go:206-267)."""
         from ..encoding import output_size
 
+        net0 = link_model().stats()
         cns = self.roster.of_role("cn")
         dps = self.roster.of_role("dp")
         vns = self.roster.of_role("vn")
@@ -1006,22 +1160,28 @@ class RemoteClient:
 
             sig_pubs = {}
             for (u, _l) in group_ranges(ranges):
+                outs = fan_out(cns,
+                               lambda e, u=u: {"type": "range_sig", "u": u},
+                               policy=self.policy)
                 pubs = []
-                for e in cns:
-                    r = call_entry(e, {"type": "range_sig", "u": u})
+                for e, (r, err) in zip(cns, outs):
+                    if err is not None:
+                        raise err
                     pubs.append([int(t) for t in r["pub"]])
                 sig_pubs[str(u)] = pubs
             expected = self.expected_proofs(
                 len(dps), len(cns), obfuscation, self._diffp_on(diffp))
-            for e in vns:
-                call_entry(e, {
-                    "type": "vn_register", "survey_id": survey_id,
-                    "expected": expected, "proofs": True,
-                    "expected_range": len(dps),
-                    "thresholds": {t: thresholds for t in rq.PROOF_TYPES},
-                    "client_pub": list(self.public),
-                    "ranges": [list(r) for r in ranges],
-                    "range_sig_pubs": sig_pubs})
+            reg = {"type": "vn_register", "survey_id": survey_id,
+                   "expected": expected, "proofs": True,
+                   "expected_range": len(dps),
+                   "thresholds": {t: thresholds for t in rq.PROOF_TYPES},
+                   "client_pub": list(self.public),
+                   "ranges": [list(r) for r in ranges],
+                   "range_sig_pubs": sig_pubs}
+            for e, (_r, err) in zip(vns, fan_out(vns, lambda e: dict(reg),
+                                                 policy=self.policy)):
+                if err is not None:
+                    raise err
 
         lrp_msg = None
         if lr_params is not None:
@@ -1064,6 +1224,7 @@ class RemoteClient:
                                        query_min, query_max)
         else:
             result = st.decode(op, dec, query_min, query_max)
+        self.last_net = _net_delta(net0, link_model().stats())
         if not proofs:
             return result
 
@@ -1076,6 +1237,7 @@ class RemoteClient:
                                      "vn_quorum": float(vn_quorum)},
                            timeout=2 * timeout + 3 * rp.STRAGGLER_GRACE_S,
                            policy=self.policy)
+        self.last_net = _net_delta(net0, link_model().stats())
         return result, block
 
     # -- remote skipchain audit (reference api_skipchain.go:48-106:
@@ -1120,4 +1282,5 @@ class RemoteClient:
             call_entry(e, {"type": "close_db"})
 
 
-__all__ = ["RosterEntry", "Roster", "DrynxNode", "RemoteClient"]
+__all__ = ["RosterEntry", "Roster", "DrynxNode", "RemoteClient",
+           "call_entry", "fan_out"]

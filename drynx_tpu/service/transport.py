@@ -1,12 +1,31 @@
-"""TCP control plane: length-prefixed JSON messages between node processes.
+"""TCP control plane: length-prefixed frames between node processes.
 
 The reference's onet overlay (TCP + registered-message marshaling,
 services/service.go:117-139, SendProtobuf at api.go:110) maps to two planes
 on TPU (SURVEY.md §2.3): the *data plane* (ciphertext math) rides XLA
 collectives inside the device mesh, while the *control plane* (query
 distribution, DP responses from external institutions, proof envelopes) is
-host-side networking — this module. Binary tensors travel as base64 fields
-inside JSON frames; every frame is [u32 length][utf-8 JSON payload].
+host-side networking — this module.
+
+Two wire formats share one outer framing ([u32 length][body]):
+
+  v1 (JSON)    body is a UTF-8 JSON document; binary tensors travel as
+               base64 fields (~33% inflation plus codec cost on multi-MB
+               ciphertext payloads).
+  v2 (binary)  body is [u32 header_len][header JSON][u32 nsegs]
+               [u32 seg_len x nsegs][seg bytes...]; every bytes value in
+               the message tree (pack_array data, proof blobs) is pulled
+               out into a raw segment and referenced from the header as
+               {"__seg__": i}. No base64, no JSON-escaping of payload
+               bytes.
+
+The format is negotiated per connection: a client opens in v1, sends a
+``wire_hello`` (handled inside the server accept loop, invisible to the
+fault plan and to handlers), and switches to the agreed version. An old
+server answers the hello with an error reply and the connection simply
+stays v1. ``DRYNX_WIRE=json`` is the kill-switch that pins everything to
+v1. :class:`LinkModel` charges the real frame length either way, so the
+wire formats are directly comparable byte-for-byte.
 
 Failure contract: every transport failure raises a subclass of
 :class:`TransportError`. The subclasses multiply-inherit the builtin
@@ -17,6 +36,9 @@ frame exchange failed mid-flight is *broken*: the socket is in an
 undefined state (a partial frame may be on the wire), so it is closed and
 every later call raises immediately — recovery is a NEW connection,
 decided by the caller's RetryPolicy (drynx_tpu/resilience/policy.py).
+:class:`ConnPool` enforces the same contract across reuse: broken or
+closed connections are never pooled, and a pooled socket with pending
+bytes (a half-read reply from a timed-out call) is discarded on checkout.
 
 Fault injection: when a :class:`~drynx_tpu.resilience.faults.FaultPlan`
 is active (set_fault_plan), the client hooks (connect/request) and server
@@ -65,7 +87,7 @@ class FrameTooLarge(TransportError):
 
 
 class CorruptFrame(TransportError):
-    """A frame's payload did not decode as UTF-8 JSON."""
+    """A frame's body did not decode under the connection's wire format."""
 
 
 class RemoteError(TransportError, RuntimeError):
@@ -73,7 +95,7 @@ class RemoteError(TransportError, RuntimeError):
 
 
 class LinkModel:
-    """Per-message link emulation: one-way delay + serialization time.
+    """Per-message link emulation + byte accounting.
 
     Mirrors the reference simulation's per-link network model
     (simul/runfiles/drynx.toml:6-7: Delay = 20 ms, Bandwidth = 100 Mbps;
@@ -81,21 +103,47 @@ class LinkModel:
     delay + n*8/bandwidth before the bytes move, so TCP runs and the
     in-process simulation runner reproduce the reference's network rows
     with real wall-clock, not post-hoc arithmetic.
+
+    Counters (bytes_total/msgs_total/by_peer) are mutated under a lock —
+    fan_out workers charge concurrently — but the emulation sleep happens
+    OUTSIDE the lock, so concurrent sends overlap their link time exactly
+    like independent physical links would.
     """
 
     def __init__(self, delay_ms: float = 0.0, bandwidth_mbps: float = 0.0):
         self.delay_s = float(delay_ms) / 1e3
         self.byte_s = (8.0 / (float(bandwidth_mbps) * 1e6)
                        if bandwidth_mbps else 0.0)
+        self._lock = threading.Lock()
+        self.bytes_total = 0
+        self.msgs_total = 0
+        self.by_peer: dict[str, int] = {}
 
     @property
     def active(self) -> bool:
         return self.delay_s > 0 or self.byte_s > 0
 
-    def charge(self, n_bytes: int) -> None:
+    def charge(self, n_bytes: int, peer: str = "") -> None:
+        with self._lock:
+            self.bytes_total += n_bytes
+            self.msgs_total += 1
+            if peer:
+                self.by_peer[peer] = self.by_peer.get(peer, 0) + n_bytes
         t = self.delay_s + n_bytes * self.byte_s
         if t > 0:
             time.sleep(t)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"bytes_total": self.bytes_total,
+                    "msgs_total": self.msgs_total,
+                    "by_peer": dict(self.by_peer)}
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.bytes_total = 0
+            self.msgs_total = 0
+            self.by_peer = {}
 
     @classmethod
     def from_env(cls) -> "LinkModel":
@@ -136,14 +184,20 @@ def b64(data: bytes) -> str:
     return base64.b64encode(data).decode()
 
 
-def unb64(s: str) -> bytes:
+def unb64(s) -> bytes:
+    """Binary field decoder, wire-agnostic: v1 delivers base64 strings,
+    v2 delivers raw bytes segments. Handlers call this and never care."""
+    if isinstance(s, (bytes, bytearray, memoryview)):
+        return bytes(s)
     return base64.b64decode(s.encode())
 
 
 def pack_array(a) -> dict:
+    """Tensor -> message field. ``data`` is raw bytes; the v1 encoder
+    base64s it at frame time, the v2 encoder ships it as a segment."""
     a = np.asarray(a)
     return {"dtype": str(a.dtype), "shape": list(a.shape),
-            "data": b64(a.tobytes())}
+            "data": a.tobytes()}
 
 
 def unpack_array(d: dict) -> np.ndarray:
@@ -151,17 +205,179 @@ def unpack_array(d: dict) -> np.ndarray:
                          dtype=np.dtype(d["dtype"])).reshape(d["shape"])
 
 
-def send_msg(sock: socket.socket, obj: dict) -> None:
-    raw = json.dumps(obj).encode()
-    link_model().charge(len(raw) + 4)
-    sock.sendall(len(raw).to_bytes(4, "big") + raw)
+# ---------------------------------------------------------------------------
+# Wire formats
+# ---------------------------------------------------------------------------
+
+def wire_default() -> int:
+    """The wire version this process offers. ``DRYNX_WIRE=json`` (or v1/1)
+    is the kill-switch pinning everything to the legacy JSON frames."""
+    w = os.environ.get("DRYNX_WIRE", "").strip().lower()
+    if w in ("json", "v1", "1"):
+        return 1
+    return 2
 
 
-def recv_msg(sock: socket.socket,
-             max_bytes: Optional[int] = None) -> Optional[dict]:
+def _json_default(o):
+    """v1 compatibility hook: bytes fields become base64 strings, exactly
+    the shape the pre-v2 wire shipped."""
+    if isinstance(o, (bytes, bytearray, memoryview)):
+        return b64(bytes(o))
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+def jsonable(obj):
+    """Deep-copy a message tree into pure-JSON types (bytes -> base64
+    strings) for callers that persist or hash messages outside the wire
+    (block storage, transcript digests)."""
+    return json.loads(json.dumps(obj, default=_json_default))
+
+
+_SEG_KEY = "__seg__"
+_NARROW_KEY = "w"
+# limb convention: the crypto layers carry 16-bit limbs in uint32 slots
+# (and small int64 host values), so most tensor payloads narrow 2-8x
+# losslessly on the wire — a bigger saving than dropping base64 alone
+_NARROW = {"u": [np.uint8, np.uint16, np.uint32],
+           "i": [np.int8, np.int16, np.int32]}
+
+
+def _narrow_seg(dtype: str, data: bytes):
+    """(wire_bytes, wire_dtype) for a packed-array payload, shipping the
+    smallest integer dtype that holds every value exactly; (data, None)
+    when narrowing doesn't apply. Lossless by construction: the decoder
+    widens back to ``dtype`` before any handler sees the bytes."""
+    try:
+        dt = np.dtype(dtype)
+        if dt.kind not in _NARROW or dt.itemsize <= 1 or not data:
+            return data, None
+        a = np.frombuffer(data, dtype=dt)
+        lo, hi = int(a.min()), int(a.max())
+        for cand in _NARROW[dt.kind]:
+            cdt = np.dtype(cand)
+            if cdt.itemsize >= dt.itemsize:
+                break
+            info = np.iinfo(cdt)
+            if info.min <= lo and hi <= info.max:
+                return a.astype(cdt).tobytes(), cdt.name
+        return data, None
+    except (ValueError, TypeError):
+        return data, None
+
+
+def _encode_v2(obj: dict) -> bytes:
+    """Body of a v2 frame: [u32 header_len][header JSON][u32 nsegs]
+    [u32 seg_len x nsegs][seg bytes...]. Integer tensor payloads are
+    narrowed to their smallest lossless dtype (see _narrow_seg)."""
+    segs: list[bytes] = []
+
+    def ref(data: bytes, narrowed=None):
+        segs.append(data)
+        r = {_SEG_KEY: len(segs) - 1}
+        if narrowed:
+            r[_NARROW_KEY] = narrowed
+        return r
+
+    def strip(o):
+        if isinstance(o, (bytes, bytearray, memoryview)):
+            return ref(bytes(o))
+        if isinstance(o, dict):
+            if isinstance(o.get("data"), (bytes, bytearray, memoryview)) \
+                    and isinstance(o.get("dtype"), str):
+                wire_bytes, wdt = _narrow_seg(o["dtype"], bytes(o["data"]))
+                nw = [wdt, o["dtype"]] if wdt else None
+                return {k: (ref(wire_bytes, nw) if k == "data"
+                            else strip(v)) for k, v in o.items()}
+            return {k: strip(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return [strip(v) for v in o]
+        return o
+
+    header = json.dumps(strip(obj)).encode()
+    parts = [len(header).to_bytes(4, "big"), header,
+             len(segs).to_bytes(4, "big")]
+    for s in segs:
+        parts.append(len(s).to_bytes(4, "big"))
+    parts.extend(segs)
+    return b"".join(parts)
+
+
+def _decode_v2(body: bytes) -> dict:
+    try:
+        if len(body) < 8:
+            raise ValueError("truncated v2 body")
+        hl = int.from_bytes(body[:4], "big")
+        if 4 + hl + 4 > len(body):
+            raise ValueError(f"header length {hl} exceeds body")
+        header = json.loads(body[4:4 + hl].decode())
+        off = 4 + hl
+        nsegs = int.from_bytes(body[off:off + 4], "big")
+        off += 4
+        if off + 4 * nsegs > len(body):
+            raise ValueError(f"segment table ({nsegs}) exceeds body")
+        lens = []
+        for _ in range(nsegs):
+            lens.append(int.from_bytes(body[off:off + 4], "big"))
+            off += 4
+        segs: list[bytes] = []
+        for n in lens:
+            if off + n > len(body):
+                raise ValueError("segment exceeds body")
+            segs.append(body[off:off + n])
+            off += n
+
+        def fill(o):
+            if isinstance(o, dict):
+                if _SEG_KEY in o and set(o) <= {_SEG_KEY, _NARROW_KEY}:
+                    raw = segs[o[_SEG_KEY]]
+                    nw = o.get(_NARROW_KEY)
+                    if nw is None:
+                        return raw
+                    wire_dt, orig_dt = nw
+                    return np.frombuffer(raw, dtype=np.dtype(wire_dt)) \
+                        .astype(np.dtype(orig_dt)).tobytes()
+                return {k: fill(v) for k, v in o.items()}
+            if isinstance(o, list):
+                return [fill(v) for v in o]
+            return o
+
+        return fill(header)
+    except (UnicodeDecodeError, ValueError, KeyError,
+            IndexError, TypeError) as e:
+        raise CorruptFrame(f"undecodable {len(body)}-byte v2 frame: "
+                           f"{e}") from e
+
+
+def encode_frame(obj: dict, wire: int = 1) -> bytes:
+    """Complete on-wire bytes (outer length prefix included)."""
+    if wire >= 2:
+        body = _encode_v2(obj)
+    else:
+        body = json.dumps(obj, default=_json_default).encode()
+    return len(body).to_bytes(4, "big") + body
+
+
+def decode_frame(body: bytes, wire: int = 1) -> dict:
+    if wire >= 2:
+        return _decode_v2(body)
+    try:
+        return json.loads(body.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise CorruptFrame(f"undecodable {len(body)}-byte frame: {e}") from e
+
+
+def send_frame(sock: socket.socket, obj: dict, wire: int = 1,
+               peer: str = "") -> None:
+    frame = encode_frame(obj, wire)
+    link_model().charge(len(frame), peer)
+    sock.sendall(frame)
+
+
+def recv_frame(sock: socket.socket, wire: int = 1,
+               max_bytes: Optional[int] = None) -> Optional[dict]:
     """One frame, or None on clean EOF. Raises :class:`FrameTooLarge`
     before allocating anything for an oversized header and
-    :class:`CorruptFrame` when the payload isn't UTF-8 JSON."""
+    :class:`CorruptFrame` when the body doesn't decode under ``wire``."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
@@ -174,10 +390,18 @@ def recv_msg(sock: socket.socket,
     body = _recv_exact(sock, n)
     if body is None:
         return None
-    try:
-        return json.loads(body.decode())
-    except (UnicodeDecodeError, ValueError) as e:
-        raise CorruptFrame(f"undecodable {n}-byte frame: {e}") from e
+    return decode_frame(body, wire)
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    """Legacy v1 send (raw-socket callers outside a negotiated Conn)."""
+    send_frame(sock, obj, 1)
+
+
+def recv_msg(sock: socket.socket,
+             max_bytes: Optional[int] = None) -> Optional[dict]:
+    """Legacy v1 receive."""
+    return recv_frame(sock, 1, max_bytes)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -190,24 +414,26 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def _send_faulted_frame(sock: socket.socket, obj: dict,
+def _send_faulted_frame(sock: socket.socket, frame: bytes,
                         act: faults.FaultSpec) -> bool:
-    """Emit (or suppress) one frame according to a request/reply fault.
-    Returns False when the connection must be torn down afterwards."""
-    raw = json.dumps(obj).encode()
+    """Emit (or suppress) one pre-encoded frame according to a
+    request/reply fault. Returns False when the connection must be torn
+    down afterwards. ``frame`` is the complete on-wire bytes; corrupting
+    offset 4 (first body byte) breaks both wires deterministically: v1's
+    first JSON byte becomes 0xFF (never valid UTF-8 JSON), v2's
+    header-length field becomes >= 0xFF000000 (always exceeds the body)."""
     if act.kind == "drop":
         return True                      # frame vanishes on the wire
     if act.kind == "delay":
         time.sleep(act.delay_s)
-        sock.sendall(len(raw).to_bytes(4, "big") + raw)
+        sock.sendall(frame)
         return True
     if act.kind == "corrupt":
-        # same length, first byte 0xFF: never valid UTF-8 JSON
-        raw = b"\xff" + raw[1:]
-        sock.sendall(len(raw).to_bytes(4, "big") + raw)
+        sock.sendall(frame[:4] + b"\xff" + frame[5:])
         return True
     if act.kind == "close_mid_frame":
-        sock.sendall(len(raw).to_bytes(4, "big") + raw[:max(1, len(raw) // 2)])
+        body = len(frame) - 4
+        sock.sendall(frame[:4 + max(1, body // 2)])
         try:
             sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -228,6 +454,11 @@ class NodeServer:
     ``node_name`` identifies this node to the fault plan's node/reply
     hooks (DrynxNode sets it; anonymous test servers stay exempt from
     name-targeted faults unless the plan targets "*").
+
+    Each accepted connection starts in v1 and upgrades when the client's
+    ``wire_hello`` arrives. The hello is transport-internal: it never
+    reaches ``handlers``, never consults the fault plan's request/reply
+    hooks, and so never perturbs a seeded chaos schedule.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
@@ -238,13 +469,14 @@ class NodeServer:
 
         class _H(socketserver.BaseRequestHandler):
             def handle(self):
+                wire = 1
                 while True:
                     plan = faults.fault_plan()
                     name = outer.node_name
                     if plan is not None and name and plan.killed(name):
                         return           # dead node: close without a word
                     try:
-                        msg = recv_msg(self.request)
+                        msg = recv_frame(self.request, wire)
                     except TransportError:
                         # oversized/corrupt framing is unrecoverable on a
                         # stream transport: drop the connection, the peer
@@ -253,6 +485,13 @@ class NodeServer:
                     if msg is None:
                         return
                     mtype = msg.get("type", "")
+                    if mtype == "wire_hello":
+                        agreed = min(int(msg.get("max", 1)), wire_default())
+                        send_frame(self.request,
+                                   {"type": "wire_hello_reply",
+                                    "wire": agreed}, wire)
+                        wire = agreed
+                        continue
                     if plan is not None and name:
                         nf = plan.node_fault(name)
                         if nf is not None and nf.kind == "kill":
@@ -270,10 +509,12 @@ class NodeServer:
                     act = (plan.pick("reply", name, mtype)
                            if plan is not None and name else None)
                     if act is not None:
-                        if not _send_faulted_frame(self.request, reply, act):
+                        frame = encode_frame(reply, wire)
+                        if not _send_faulted_frame(self.request, frame,
+                                                   act):
                             return
                         continue
-                    send_msg(self.request, reply)
+                    send_frame(self.request, reply, wire)
 
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -308,13 +549,22 @@ class Conn:
     ``broken``: closed, and every later call raises ConnectionClosed.
     ``sent`` reports whether the *last* call wrote any request bytes —
     the retry policy's idempotency gate reads it.
+
+    Right after the TCP connect, the client negotiates the wire format
+    (unless this process is pinned to v1 by ``DRYNX_WIRE=json``): one
+    plain v1 ``wire_hello`` round-trip, invisible to fault hooks. A peer
+    that errors the hello (an old server) leaves the connection on v1.
     """
 
     def __init__(self, host: str, port: int,
                  timeout: float = rp.CALL_TIMEOUT_S, peer: str = ""):
         self.peer = peer or f"{host}:{port}"
+        self.host, self.port = host, int(port)
         self.broken = False
+        self.closed = False
         self.sent = False
+        self.wire = 1
+        self._timeout = float(timeout)
         self._lock = threading.Lock()
         plan = faults.fault_plan()
         if plan is not None:
@@ -333,10 +583,28 @@ class Conn:
                                                  timeout=timeout)
         except OSError as e:
             raise ConnectError(f"connect to {self.peer} failed: {e}") from e
+        want = wire_default()
+        if want >= 2:
+            try:
+                send_frame(self.sock, {"type": "wire_hello", "max": want},
+                           1, peer=self.peer)
+                reply = recv_frame(self.sock, 1)
+                if (reply is not None and reply.get("type") != "error"
+                        and int(reply.get("wire", 1)) >= 2):
+                    self.wire = 2
+            except (TransportError, OSError) as e:
+                self._mark_broken()
+                raise ConnectError(
+                    f"wire negotiation with {self.peer} failed: {e}") from e
+            if reply is None:
+                self._mark_broken()
+                raise ConnectError(
+                    f"connection closed by {self.peer} during wire "
+                    f"negotiation")
 
     def call(self, obj: dict) -> dict:
         mtype = obj.get("type", "")
-        if self.broken:
+        if self.broken or self.closed:
             raise ConnectionClosed(
                 f"connection to {self.peer} already broken")
         with self._lock:
@@ -347,15 +615,16 @@ class Conn:
                        if plan is not None else None)
                 if act is not None:
                     self.sent = True
-                    if not _send_faulted_frame(self.sock, obj, act):
+                    frame = encode_frame(obj, self.wire)
+                    if not _send_faulted_frame(self.sock, frame, act):
                         self._mark_broken()
                         raise ConnectionClosed(
                             f"connection to {self.peer} lost after partial "
                             f"write of {mtype!r} (fault plan)")
                 else:
-                    send_msg(self.sock, obj)
+                    send_frame(self.sock, obj, self.wire, peer=self.peer)
                     self.sent = True
-                reply = recv_msg(self.sock)
+                reply = recv_frame(self.sock, self.wire)
             except ConnectionClosed:
                 raise
             except socket.timeout as e:
@@ -387,7 +656,151 @@ class Conn:
             pass
 
     def close(self) -> None:
+        self.closed = True
         self.sock.close()
+
+
+class ConnPool:
+    """Per-process connection reuse, keyed by (peer, host, port).
+
+    Replaces the connect-per-RPC pattern: ``call_entry`` checks a
+    connection out, runs one request/response, and returns it on success
+    (RemoteError included — the conn is healthy, the handler raised).
+    Anything that broke the frame exchange (CallTimeout, ConnectionClosed,
+    CorruptFrame, OSError) leaves the conn ``broken`` and :meth:`put`
+    refuses it, so a half-read reply can never desync a later caller.
+
+    Checkout re-validates with a zero-timeout MSG_PEEK: EOF (the server
+    restarted) or stray buffered bytes (a reply that arrived after its
+    caller timed out) both disqualify the socket. Idle depth per key is
+    bounded by ``max_idle`` (rp.CONN_POOL_MAX_IDLE); beyond it, returned
+    connections are closed, keeping the fd footprint at
+    len(roster) * max_idle.
+
+    The FaultPlan ``connect`` hook fires only on real (re)connects —
+    reuse never consults it, which keeps seeded chaos schedules
+    independent of pool hit rates (faults.py keys draws per node, not by
+    global arrival order).
+    """
+
+    def __init__(self, max_idle: int = rp.CONN_POOL_MAX_IDLE):
+        self.max_idle = int(max_idle)
+        self._lock = threading.Lock()
+        self._idle: dict[tuple, list[Conn]] = {}
+        self.connects = 0
+        self.reuses = 0
+        self.discards = 0
+
+    @staticmethod
+    def _key(conn: Conn) -> tuple:
+        return (conn.peer, conn.host, conn.port)
+
+    def get(self, host: str, port: int,
+            timeout: float = rp.CALL_TIMEOUT_S, peer: str = "") -> Conn:
+        key = (peer or f"{host}:{port}", host, int(port))
+        while True:
+            with self._lock:
+                stack = self._idle.get(key)
+                conn = stack.pop() if stack else None
+            if conn is None:
+                break
+            if self._healthy(conn, timeout):
+                with self._lock:
+                    self.reuses += 1
+                conn._timeout = float(timeout)
+                return conn
+            self.discard(conn)
+        conn = Conn(host, port, timeout=timeout, peer=peer)
+        with self._lock:
+            self.connects += 1
+        return conn
+
+    @staticmethod
+    def _healthy(conn: Conn, timeout: float) -> bool:
+        if conn.broken or conn.closed:
+            return False
+        try:
+            conn.sock.setblocking(False)
+            try:
+                conn.sock.recv(1, socket.MSG_PEEK)
+            except (BlockingIOError, InterruptedError):
+                return True          # nothing pending: idle and alive
+            finally:
+                conn.sock.settimeout(timeout)
+            return False             # EOF (b"") or stray bytes: desynced
+        except OSError:
+            return False
+
+    def put(self, conn: Optional[Conn]) -> None:
+        if conn is None:
+            return
+        if conn.broken or conn.closed:
+            self.discard(conn)
+            return
+        key = self._key(conn)
+        with self._lock:
+            stack = self._idle.setdefault(key, [])
+            if len(stack) < self.max_idle:
+                stack.append(conn)
+                return
+        self.discard(conn)
+
+    def discard(self, conn: Optional[Conn]) -> None:
+        if conn is None:
+            return
+        with self._lock:
+            self.discards += 1
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        conn.closed = True
+
+    def close_all(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, {}
+        for stack in idle.values():
+            for conn in stack:
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+                conn.closed = True
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._idle.values())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"connects": self.connects, "reuses": self.reuses,
+                    "discards": self.discards,
+                    "idle": sum(len(s) for s in self._idle.values())}
+
+
+_POOL: Optional[ConnPool] = None
+
+
+def pool_enabled() -> bool:
+    """DRYNX_CONN_POOL=off is the kill-switch back to connect-per-RPC."""
+    return os.environ.get("DRYNX_CONN_POOL",
+                          "").strip().lower() not in ("off", "0", "no")
+
+
+def conn_pool() -> Optional[ConnPool]:
+    global _POOL
+    if not pool_enabled():
+        return None
+    if _POOL is None:
+        _POOL = ConnPool()
+    return _POOL
+
+
+def set_conn_pool(p: Optional[ConnPool]) -> None:
+    global _POOL
+    if _POOL is not None and _POOL is not p:
+        _POOL.close_all()
+    _POOL = p
 
 
 def local_call(peer: str, mtype: str, fn, *args, **kwargs):
@@ -455,7 +868,10 @@ def local_call(peer: str, mtype: str, fn, *args, **kwargs):
 
 
 __all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
-           "recv_msg", "NodeServer", "Conn", "LinkModel", "link_model",
+           "recv_msg", "send_frame", "recv_frame", "encode_frame",
+           "decode_frame", "wire_default", "jsonable",
+           "NodeServer", "Conn", "ConnPool", "conn_pool", "set_conn_pool",
+           "pool_enabled", "LinkModel", "link_model",
            "set_link_model", "set_max_frame_bytes", "MAX_FRAME_BYTES",
            "local_call",
            "TransportError", "ConnectError", "ConnectionClosed",

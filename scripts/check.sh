@@ -44,6 +44,9 @@ python scripts/bench_scale_axes.py --cpu --smoke > /dev/null
 echo "== pool smoke (store lifecycle: create->persist->reopen->consume->refill) =="
 python scripts/pool_smoke.py > /dev/null
 
+echo "== net-plane smoke (serial/parallel/v1 survey over one supervised child) =="
+python scripts/bench_net_plane.py --smoke > /dev/null
+
 echo "== server tier (standing scheduler quick tests + 3-survey demo) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not slow' \
     tests/test_server.py

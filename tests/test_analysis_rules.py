@@ -306,6 +306,35 @@ def test_hardcoded_timeout_outside_drynx_pkg_is_ignored():
                rule="hardcoded-timeout") == []
 
 
+def test_hardcoded_timeout_covers_network_plane_knobs():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fan(entries, workers=8):
+            pool = make_pool(max_idle=4)
+            ex = ThreadPoolExecutor(max_workers=6)
+            srv = serve(conn_pool_size=12)
+    """
+    found = run(src, relpath=SERVICE, rule="hardcoded-timeout")
+    assert len(found) == 4
+    texts = " ".join(f.message for f in found)
+    assert "workers=8" in texts and "max_idle=4" in texts
+    assert "max_workers=6" in texts and "conn_pool_size=12" in texts
+
+
+def test_hardcoded_timeout_allows_named_network_plane_knobs():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+        from drynx_tpu.resilience import policy as rp
+
+        def fan(entries, workers=None, n=0):
+            ex = ThreadPoolExecutor(max_workers=rp.FAN_OUT_WORKERS)
+            pool = make_pool(max_idle=rp.CONN_POOL_MAX_IDLE)
+            other(workers=n)
+    """
+    assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
+
+
 # -- suppression + baseline mechanics ---------------------------------------
 
 def test_noqa_suppresses_named_rule_only():
