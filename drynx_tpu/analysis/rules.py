@@ -460,7 +460,16 @@ class HardcodedTimeout(Rule):
     ``max_workers=8`` decides how hard a survey hammers a roster exactly
     like a bare ``timeout=900`` decides how long it stalls — both live as
     named constants in resilience/policy.py (FAN_OUT_WORKERS,
-    CONN_POOL_MAX_IDLE)."""
+    CONN_POOL_MAX_IDLE).
+
+    The tree overlay (PR 11) added a third family: tree fanout and pool
+    caps (fanout=/tree_fanout=/pool_max=), surfaced as the
+    DRYNX_TREE_FANOUT / DRYNX_TOPOLOGY / DRYNX_CONN_POOL_MAX env knobs.
+    A literal ``fanout=8`` shapes dispatch depth — and a numeric literal
+    fallback in ``.get("DRYNX_CONN_POOL_MAX", 1024)`` silently forks the
+    default away from policy — so both route through TREE_FANOUT_MIN/MAX
+    and CONN_POOL_MAX instead (env fallbacks stay string-typed, which
+    this rule ignores by design)."""
 
     id = "hardcoded-timeout"
     summary = ("bare numeric timeout/retry/worker-pool literal outside "
@@ -475,7 +484,9 @@ class HardcodedTimeout(Rule):
                 or n.endswith("deadline")
                 or n == "workers" or n.endswith("_workers")
                 or n == "max_idle" or n.endswith("_idle")
-                or n == "pool_size" or n.endswith("_pool_size"))
+                or n == "pool_size" or n.endswith("_pool_size")
+                or n == "fanout" or n.endswith("_fanout")
+                or n == "pool_max" or n.endswith("_pool_max"))
 
     @staticmethod
     def _nonzero_num(node: ast.AST) -> bool:

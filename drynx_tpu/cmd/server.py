@@ -39,7 +39,18 @@ def cmd_gen(args) -> int:
 
 
 def cmd_run(args) -> int:
+    import os
+
     from ..service.node import DrynxNode
+
+    # Tree-role wiring: the overlay is derived from the dialed roster, so a
+    # relay needs no config — but the *dispatching* root reads these knobs,
+    # and any process may become root for a survey it initiates. CLI flags
+    # land in the env so service/topology.py sees one source of truth.
+    if args.topology:
+        os.environ["DRYNX_TOPOLOGY"] = args.topology
+    if args.tree_fanout:
+        os.environ["DRYNX_TREE_FANOUT"] = str(args.tree_fanout)
 
     cfg = toml_io.loads(sys.stdin.read())["node"]
     data = None
@@ -93,6 +104,16 @@ def main(argv=None) -> int:
                         "for shuffle contributions + persisted sig/fb "
                         "tables warm-start this process. $DRYNX_POOL_DIR "
                         "is the env equivalent.")
+    r.add_argument("--topology", default=None, choices=["tree", "star"],
+                   help="survey dispatch overlay when this node roots a "
+                        "survey: tree (default) relays contributions up a "
+                        "roster-derived forest; star is the flat fan-out "
+                        "kill-switch. $DRYNX_TOPOLOGY is the env "
+                        "equivalent.")
+    r.add_argument("--tree-fanout", type=int, default=None,
+                   help="tree branching factor override (else "
+                        "ceil(sqrt(n)) clamped to policy bounds). "
+                        "$DRYNX_TREE_FANOUT is the env equivalent.")
     r.set_defaults(fn=cmd_run)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -77,6 +77,16 @@ class Profile:
                             # registries stay a subset of pooled ones
                             # (test_precompile.py enforces both
                             # directions, mirroring n_buckets).
+    n_fold: int = 0         # tree-overlay fold stack height
+                            # (service/topology.fold_cts): the LARGEST
+                            # (k, V) ciphertext stack one node folds —
+                            # 1 + tree fanout at a relay hop, or the
+                            # root's top-level partial count. > 1 adds
+                            # ct_add at the halving fold widths plus the
+                            # canon g1_normalize batch (_fold_schemas).
+                            # 0 (default) = star dispatch, no extra
+                            # programs, so star registries stay a subset
+                            # of tree ones (test_precompile.py pattern).
 
 
 BENCH = Profile()
@@ -273,6 +283,11 @@ _B_SCHEMAS: list = [
      [lambda p: p.n_dps * p.n_values * p.l,
       lambda p: p.n_cns * p.n_dps * p.n_values * p.l],
      "RangeProofCreate", "g1"),
+    # canonical aggregate (topology.canon_points): the root normalizes the
+    # folded (V, 2, 3, NL) ciphertext sum — 2*V flattened points — in
+    # BOTH dispatch topologies, so this is a base program, not a tree one
+    ("g1_normalize", lambda p, b: (_g1(b),),
+     [lambda p: 2 * p.n_values], "Aggregation", "g1"),
     ("fixed_base_mul", lambda p, b: (_fb_table(), _scalar(b)),
      [lambda p: p.n_dps * p.n_values,
       lambda p: p.n_dps * p.n_values * p.l],
@@ -376,6 +391,31 @@ def _shard_schemas(p: Profile) -> list:
          [ncsl], "RangeProofCreateShard", "pallas"),
         ("gt_pow_gtb", lambda p, b: (_scalar(b),),
          [csl], "RangeProofCreateShard", "pallas"),
+    ]
+
+
+def _fold_schemas(p: Profile) -> list:
+    """The tree-overlay fold program set (service/topology.fold_cts): a
+    relay — or the tree root — folds a (k, V) ciphertext stack with
+    tree_reduce_add, dispatching ct_add at the halving widths of k, then
+    canonicalizes via g1_normalize over the flattened 2*V point batch.
+    ``n_fold`` is the largest such k the deployment folds (1 + tree
+    fanout at a relay hop, or the root's partial count). Empty when
+    n_fold <= 1, so star registries stay a subset of tree ones
+    (tests/test_precompile.py pattern for optional axes)."""
+    if p.n_fold <= 1:
+        return []
+    widths = []
+    n = p.n_fold
+    while n > 1:
+        widths.append(n // 2)        # batch of one tree_reduce_add level
+        n = n // 2 + (n % 2)
+    batches = sorted({w * p.n_values for w in widths})
+    return [
+        ("ct_add", lambda p, b: (_ct(b), _ct(b)),
+         [(lambda p, bb=bb: bb) for bb in batches], "TreeFold", "device"),
+        ("g1_normalize", lambda p, b: (_g1(b),),
+         [lambda p: 2 * p.n_values], "TreeFold", "g1"),
     ]
 
 
@@ -709,7 +749,8 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     specs: dict[str, ProgramSpec] = {}
     for op, args_fn, batches, phase, gate in (
             _B_SCHEMAS + _shard_schemas(profile)
-            + _queue_schemas(profile) + _bucket_schemas(profile)):
+            + _queue_schemas(profile) + _bucket_schemas(profile)
+            + _fold_schemas(profile)):
         w = B.BUCKETED_OPS.get(op)
         for bexpr in batches:
             batch = int(bexpr(profile))

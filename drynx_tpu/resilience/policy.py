@@ -72,6 +72,26 @@ FAN_OUT_WORKERS = 8
 # pooled — a bounded steady-state fd footprint of
 # len(roster) * CONN_POOL_MAX_IDLE per client process.
 CONN_POOL_MAX_IDLE = 4
+# Global idle-socket ceiling across ALL peers in one pool: at a 256-DP
+# roster the per-key bound alone still means hundreds of live fds at the
+# root. Past this total, the least-recently-used idle connection (any
+# peer) is closed. Generous by default — it exists to bound the fd
+# footprint, not to thrash warm sockets. DRYNX_CONN_POOL_MAX overrides.
+CONN_POOL_MAX = 1024
+
+# -- tree-topology knobs (PR 11) --------------------------------------------
+# Roster-derived tree overlay (service/topology.py). Auto branching factor
+# is ceil(sqrt(n)) clamped to [TREE_FANOUT_MIN, TREE_FANOUT_MAX]: sqrt
+# balances depth against per-relay fan-in, the cap keeps one relay's
+# concurrent child RPCs within FAN_OUT_WORKERS territory.
+# DRYNX_TREE_FANOUT overrides; DRYNX_TOPOLOGY=star disables the overlay.
+TREE_FANOUT_MIN = 2
+TREE_FANOUT_MAX = 8
+# survey_dp reply cache (satellite of ROADMAP item 6): finished surveys'
+# cached DP replies kept per node so a tree re-dispatch after a relay
+# timeout replays bytes instead of re-encrypting (and never double-fires
+# proofs). Small — one entry is one survey's ciphertext payload.
+DP_REPLY_CACHE_MAX = 8
 
 # -- idempotency table ------------------------------------------------------
 # Read-only or set-once-overwrite handlers: re-execution is harmless.
@@ -82,9 +102,13 @@ IDEMPOTENT_MTYPES = frozenset({
 })
 # Handlers that mutate survey state / consume entropy / fan out proofs:
 # re-sending after a partial write can double-count a contribution.
+# Tree relay dispatch deliberately reuses the survey_dp / vn_bitmap
+# mtypes (extra fields route to the relay path) so fault plans and this
+# table apply unchanged at every hop; proof_batch records a whole relay
+# hop's proof verdicts at a VN — it mutates per-survey audit state.
 CONTRIBUTION_MTYPES = frozenset({
     "survey_query", "survey_dp", "obf_contrib", "shuffle_contrib",
-    "ks_contrib", "proof_request", "end_verification",
+    "ks_contrib", "proof_request", "proof_batch", "end_verification",
 })
 
 
@@ -140,4 +164,5 @@ __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
            "VERIFY_WAIT_S", "PROOF_DRAIN_S", "STRAGGLER_GRACE_S",
            "VN_GROUP_WAIT_S", "POLL_INTERVAL_S", "COLD_COMPILE_WAIT_S",
            "END_VERIFICATION_TIMEOUT_S", "SUBPROCESS_TIMEOUT_S",
-           "FAN_OUT_WORKERS", "CONN_POOL_MAX_IDLE"]
+           "FAN_OUT_WORKERS", "CONN_POOL_MAX_IDLE", "CONN_POOL_MAX",
+           "TREE_FANOUT_MIN", "TREE_FANOUT_MAX", "DP_REPLY_CACHE_MAX"]

@@ -60,20 +60,25 @@ from .. import pool as pool_mod
 from ..proofs.safe_pickle import safe_loads
 from ..resilience import policy as rp
 from ..utils import log
+from . import topology as topo
 from .proof_collection import VerifyingNode
 from .skipchain import DataBlock
 from .transport import (ConnectError, Conn, NodeServer, RemoteError,
-                        TransportError, conn_pool, link_model,
-                        pack_array, unpack_array)
+                        TransportError, conn_pool, current_node,
+                        link_model, pack_array, set_current_node,
+                        unpack_array)
 
 
 def _net_delta(before: dict, after: dict) -> dict:
     """LinkModel stats delta over one survey (process-global counters)."""
     peers = {k: v - before["by_peer"].get(k, 0)
              for k, v in after["by_peer"].items()}
+    rx = {k: v - before.get("rx_by_node", {}).get(k, 0)
+          for k, v in after.get("rx_by_node", {}).items()}
     return {"bytes_total": after["bytes_total"] - before["bytes_total"],
             "msgs_total": after["msgs_total"] - before["msgs_total"],
-            "by_peer": {k: v for k, v in peers.items() if v}}
+            "by_peer": {k: v for k, v in peers.items() if v},
+            "rx_by_node": {k: v for k, v in rx.items() if v}}
 
 
 def _pack_bytes(b: bytes) -> dict:
@@ -197,8 +202,17 @@ def fan_out(entries, make_msg: Callable, call: Callable = None,
             except Exception as err:
                 results[i] = (None, err)
         return results
+    # carry the caller's node identity onto the pool threads: replies read
+    # on a worker must be charged to the DIALING node's rx ledger, and a
+    # tree relay fans out from a server handler thread that set it
+    amb = current_node()
+
+    def run(e, m):
+        set_current_node(amb)
+        return call(e, m)
+
     with ThreadPoolExecutor(max_workers=n) as ex:
-        futs = {ex.submit(call, e, m): i
+        futs = {ex.submit(run, e, m): i
                 for i, (e, m) in enumerate(zip(entries, msgs))}
         for f in as_completed(futs):
             i = futs[f]
@@ -271,6 +285,12 @@ class DrynxNode:
         self._range_sigs: dict[int, rproof.RangeSig] = {}  # CN role, per u
         self._survey_ctx: dict[str, dict] = {}             # VN role
         self._proof_threads: dict[str, list] = {}          # prover roles
+        # DP role: per-survey cached contribution (insertion-ordered;
+        # pruned to rp.DP_REPLY_CACHE_MAX finished surveys). A tree
+        # re-dispatch after a relay timeout replays the SAME ciphertext
+        # bytes instead of re-encrypting, so a contribution can never be
+        # double-counted and its range proof never double-fires.
+        self._dp_replies: dict[str, dict] = {}
         self._state_lock = threading.Lock()  # handlers run on server threads
 
         s = self.server
@@ -282,6 +302,7 @@ class DrynxNode:
         s.register("shuffle_contrib", self._h_shuffle_contrib)
         s.register("ks_contrib", self._h_ks_contrib)
         s.register("proof_request", self._h_proof_request)
+        s.register("proof_batch", self._h_proof_batch)
         s.register("vn_register", self._h_vn_register)
         s.register("vn_adjust", self._h_vn_adjust)
         s.register("vn_bitmap", self._h_vn_bitmap)
@@ -376,28 +397,17 @@ class DrynxNode:
     # Async proof delivery to every VN (the reference's goroutine pipeline,
     # data_collection_protocol.go:279-347)
     # ------------------------------------------------------------------
-    def _send_proof_async(self, ptype: str, survey_id: str, differ: str,
-                          data: bytes) -> threading.Thread:
-        req = rq.new_proof_request(ptype, survey_id, self.name, differ, 0,
-                                   data, self.secret)
-        vns = self.roster.of_role("vn")
+    @staticmethod
+    def _proof_fields(req) -> dict:
+        """Wire form of one signed ProofRequest (minus the mtype): the unit
+        a relay hop batches and a VN unbatches."""
+        return {"proof_type": req.proof_type, "survey_id": req.survey_id,
+                "sender_id": req.sender_id, "differ_info": req.differ_info,
+                "round_id": req.round_id, "data": _pack_bytes(req.data),
+                "signature": _pack_bytes(req.signature.to_bytes())}
 
-        def work():
-            frame = {"type": "proof_request", "proof_type": ptype,
-                     "survey_id": survey_id, "sender_id": self.name,
-                     "differ_info": differ, "round_id": 0,
-                     "data": _pack_bytes(req.data),
-                     "signature": _pack_bytes(req.signature.to_bytes())}
-            outs = fan_out(vns, lambda e: dict(frame), policy=self.policy)
-            for e, (_r, err) in zip(vns, outs):
-                if err is not None:
-                    # an unreachable/erroring VN simply never counts this
-                    # proof; the end_verification counter gate reports the
-                    # shortfall. The REMAINING VNs were still delivered to.
-                    log.warn(f"{self.name}: {ptype} proof undeliverable to "
-                             f"VN {e.name}: {err}")
-
-        t = threading.Thread(target=work, daemon=True)
+    def _track_proof_thread(self, survey_id: str,
+                            t: threading.Thread) -> threading.Thread:
         t.start()
         # prune finished surveys' threads so long-lived DP/CN processes don't
         # accumulate Thread objects across surveys (handlers run on server
@@ -412,6 +422,54 @@ class DrynxNode:
                     self._proof_threads.pop(sid, None)
             self._proof_threads.setdefault(survey_id, []).append(t)
         return t
+
+    def _send_proof_async(self, ptype: str, survey_id: str, differ: str,
+                          data: bytes) -> threading.Thread:
+        req = rq.new_proof_request(ptype, survey_id, self.name, differ, 0,
+                                   data, self.secret)
+        return self._fire_proof_request_async(req)
+
+    def _fire_proof_request_async(self, req) -> threading.Thread:
+        vns = self.roster.of_role("vn")
+
+        def work():
+            set_current_node(self.name)  # fresh thread: re-pin the identity
+            frame = {"type": "proof_request", **self._proof_fields(req)}
+            outs = fan_out(vns, lambda e: dict(frame), policy=self.policy)
+            for e, (_r, err) in zip(vns, outs):
+                if err is not None:
+                    # an unreachable/erroring VN simply never counts this
+                    # proof; the end_verification counter gate reports the
+                    # shortfall. The REMAINING VNs were still delivered to.
+                    log.warn(f"{self.name}: {req.proof_type} proof "
+                             f"undeliverable to VN {e.name}: {err}")
+
+        return self._track_proof_thread(
+            req.survey_id, threading.Thread(target=work, daemon=True))
+
+    def _send_proof_batch_async(self, survey_id: str,
+                                blobs: list) -> threading.Thread:
+        """Tree mode: the root delivers every range-proof blob the tree
+        collected as ONE proof_batch frame per VN (one RPC per VN instead
+        of one per DP per VN). Blobs are sorted by differ_info so the
+        frame — and every VN's receive order — is identical whatever
+        subtree interleaving produced the batch."""
+        vns = self.roster.of_role("vn")
+        blobs = sorted(blobs, key=lambda b: (b["proof_type"],
+                                             b["differ_info"]))
+        frame = {"type": "proof_batch", "survey_id": survey_id,
+                 "proofs": blobs}
+
+        def work():
+            set_current_node(self.name)
+            outs = fan_out(vns, lambda e: dict(frame), policy=self.policy)
+            for e, (_r, err) in zip(vns, outs):
+                if err is not None:
+                    log.warn(f"{self.name}: proof batch undeliverable to "
+                             f"VN {e.name}: {err}")
+
+        return self._track_proof_thread(
+            survey_id, threading.Thread(target=work, daemon=True))
 
     def _pub_table(self, pub: tuple) -> eg.FixedBase:
         """Fixed-base tables are key-lifetime objects: cache per affine point
@@ -455,7 +513,55 @@ class DrynxNode:
     # (data_collection_protocol.go:206-267): log_reg ((X, y) DP data +
     # LRParams + the signed-offset shift) and group-by (per-group encoding
     # over the AllPossibleGroups grid).
+    #
+    # Re-entry is IDEMPOTENT per (survey_id, this DP): the contribution is
+    # computed once and cached (_dp_reply_entry), so a tree re-dispatch
+    # after a relay failure replays the same ciphertext bytes — never a
+    # re-encryption that would double-count under aggregation, never a
+    # second range-proof firing. Frames carrying "dp_order" take the tree
+    # relay path: same mtype on purpose, so fault plans and the
+    # idempotency table apply identically at every hop.
     def _h_survey_dp(self, msg: dict) -> dict:
+        if msg.get("dp_order") is not None:
+            return self._h_survey_dp_relay(msg)
+        ent = self._dp_reply_entry(msg)
+        fire = None
+        with self._state_lock:
+            if ent["req"] is not None and not ent["fired"]:
+                ent["fired"] = True
+                fire = ent["req"]
+        if fire is not None:
+            self._fire_proof_request_async(fire)
+        return {"cts": pack_array(ent["cts"])}
+
+    def _dp_reply_entry(self, msg: dict) -> dict:
+        """The cached (computed-at-most-once) contribution for a survey.
+        Concurrent re-entries block on the per-entry lock and read the
+        first computation's result; finished foreign surveys are pruned
+        past rp.DP_REPLY_CACHE_MAX in insertion order."""
+        sid = msg["survey_id"]
+        with self._state_lock:
+            ent = self._dp_replies.get(sid)
+            if ent is None:
+                for k in list(self._dp_replies):
+                    if len(self._dp_replies) < rp.DP_REPLY_CACHE_MAX:
+                        break
+                    if self._dp_replies[k]["done"]:
+                        del self._dp_replies[k]
+                ent = {"lock": threading.Lock(), "done": False,
+                       "cts": None, "req": None, "fired": False}
+                self._dp_replies[sid] = ent
+        with ent["lock"]:
+            if not ent["done"]:
+                ent["cts"], ent["req"] = self._dp_contribution(msg)
+                ent["done"] = True
+        return ent
+
+    def _dp_contribution(self, msg: dict):
+        """Encode + encrypt this node's data for one survey. Returns
+        (cts ndarray, signed range-proof request | None) — the caller
+        decides whether the proof goes to the VNs directly (star) or rides
+        a relay hop's batch (tree)."""
         op = msg["op"]
         qmin, qmax = msg["query_min"], msg["query_max"]
         group_by = msg.get("group_by") or None
@@ -517,15 +623,98 @@ class DrynxNode:
         key = jax.random.PRNGKey(secrets.randbits(63))
         cts, rs = eg.encrypt_ints(key, tbl, jnp.asarray(stats))
 
+        req = None
         if msg.get("proofs"):
             ranges_v = [tuple(r) for r in msg["ranges"]]
             sigs_by_u = self._sigs_from_msg(msg["range_sigs"])
             key2 = jax.random.PRNGKey(secrets.randbits(63))
             lst = rproof.create_range_proof_list(
                 key2, stats, rs, cts, ranges_v, sigs_by_u, tbl.table)
-            self._send_proof_async("range", msg["survey_id"],
-                                   f"range-{self.name}", lst.to_bytes())
-        return {"cts": pack_array(np.asarray(cts))}
+            req = rq.new_proof_request("range", msg["survey_id"], self.name,
+                                       f"range-{self.name}", 0,
+                                       lst.to_bytes(), self.secret)
+        return np.asarray(cts), req
+
+    # -- tree overlay relay (frames carrying dp_order): contribute locally,
+    # collect the child subtrees, homomorphically fold everything into ONE
+    # canonical partial, and pass the hop's range-proof blobs (plus a
+    # per-hop aggregation proof the parent verifies) upward. O(log n)
+    # depth replaces the root's O(n) fan-in; the fold is exact mod-p point
+    # addition, so the root's final aggregate is the same group element —
+    # and after canon_points the same BYTES — as the star sum.
+    def _h_survey_dp_relay(self, msg: dict) -> dict:
+        order = list(msg["dp_order"])
+        n, b = len(order), int(msg["fanout"])
+        idx = int(msg["index"])
+        proofs = bool(msg.get("proofs"))
+        ent = self._dp_reply_entry(msg)
+        partials = [np.asarray(ent["cts"])]
+        responders = [self.name]
+        absent: list[str] = []
+        blobs: list[dict] = []
+        if proofs and ent["req"] is not None:
+            blobs.append(self._proof_fields(ent["req"]))
+        kids = topo.children(idx, n, b)
+        if kids:
+            by_name = {e.name: e for e in self.roster.entries}
+            idx_of = {order[c]: c for c in kids}
+            entries = [by_name[order[c]] for c in kids]
+
+            def mk(e):
+                m = dict(msg)
+                m["index"] = idx_of[e.name]
+                return m
+
+            outs = fan_out(entries, mk, policy=self.policy)
+            for e, (r, err) in zip(entries, outs):
+                if err is None:
+                    part = np.asarray(unpack_array(r["cts"]))
+                    self._check_hop_proof(r, part, proofs, e.name)
+                    partials.append(part)
+                    responders.extend(r["responders"])
+                    absent.extend(r["absent"])
+                    blobs.extend(r.get("proof_blobs") or [])
+                elif isinstance(err, RemoteError):
+                    raise err   # the child's handler ran and errored: a
+                                # real bug, not an availability fault
+                elif isinstance(err, (TransportError, OSError)):
+                    # the whole child subtree is unreached from HERE; the
+                    # root re-dispatches the failed relay's children as
+                    # subtree roots, so only the dead node itself is lost
+                    log.warn(f"{self.name}: subtree {e.name} unreachable "
+                             f"for survey {msg['survey_id']}: {err}")
+                    absent.extend(order[j] for j in
+                                  topo.subtree(idx_of[e.name], n, b))
+                else:
+                    raise err
+        if len(partials) == 1:
+            reply = {"cts": pack_array(partials[0])}
+        else:
+            stack = np.stack(partials)
+            folded = np.asarray(topo.fold_cts(stack))
+            reply = {"cts": pack_array(folded)}
+            if proofs:
+                reply["hop_proof"] = _pack_bytes(pickle.dumps(
+                    agg_proof.create_aggregation_proof(stack, folded)))
+        reply["responders"] = responders
+        reply["absent"] = absent
+        if proofs:
+            reply["proof_blobs"] = blobs
+        return reply
+
+    def _check_hop_proof(self, r: dict, part: np.ndarray, proofs: bool,
+                         child: str) -> None:
+        """Parent-side check of a relay hop's aggregation proof: the fold
+        must verify AND the proven aggregate must be the very bytes the
+        reply carries — otherwise a relay could attach a valid proof of
+        some OTHER fold."""
+        if not proofs or r.get("hop_proof") is None:
+            return
+        batch = safe_loads(_unpack_bytes(r["hop_proof"]))
+        ok = bool(np.all(agg_proof.verify_aggregation_proof(batch)))
+        if not ok or not np.array_equal(np.asarray(batch.aggregate), part):
+            raise RuntimeError(
+                f"{self.name}: relay {child} hop aggregation proof rejected")
 
     # -- CN side: obfuscation contribution — multiply every ciphertext by a
     # fresh secret scalar (reference obfuscation_protocol.go:241-243) and
@@ -623,6 +812,82 @@ class DrynxNode:
             return self.server.handlers[msg["type"]](msg)
         return call_entry(entry, msg, policy=self.policy)
 
+    def _dispatch_tree(self, dps, dp_frame: dict, proofs: bool):
+        """Tree-overlay DP dispatch from the root: contact the forest
+        roots, let relays fold their subtrees, and recover from a dead
+        relay by re-dispatching its CHILDREN as new subtree roots — never
+        the failed node itself, so a node that failed transport is not
+        re-sent its contribution request (only its own contribution is
+        lost, not its subtree's). Partials from distinct dispatches cover
+        disjoint index sets, so summing them never double-counts; the DP
+        reply cache makes the re-dispatched subtrees replay identical
+        bytes even when a torn reply hid work that already ran. Returns
+        (partials, responders roster-ordered, failed sorted, proof blobs).
+        """
+        order = [e.name for e in dps]
+        idx_of = {nm: i for i, nm in enumerate(order)}
+        n, b = len(order), topo.tree_fanout(len(order))
+        frame = {**dp_frame, "dp_order": order, "fanout": b}
+        partials: list[np.ndarray] = []
+        blobs: list[dict] = []
+        got: set[str] = set()
+        failed: set[str] = set()
+        expanded: set[int] = set()
+        wave = topo.roots(n, b)
+        while wave:
+            nxt: list[int] = []
+
+            def expand(i):
+                # at most once per index: its children become independent
+                # subtree roots in the next dispatch wave
+                if i not in expanded:
+                    expanded.add(i)
+                    nxt.extend(topo.children(i, n, b))
+
+            entries = [dps[i] for i in wave]
+            widx = {order[i]: i for i in wave}
+
+            def mk(e):
+                m = dict(frame)
+                m["index"] = widx[e.name]
+                return m
+
+            outs = fan_out(entries, mk, policy=self.policy)
+            for i, e, (r, err) in zip(wave, entries, outs):
+                if err is None:
+                    part = np.asarray(unpack_array(r["cts"]))
+                    self._check_hop_proof(r, part, proofs, e.name)
+                    partials.append(part)
+                    got.update(r["responders"])
+                    blobs.extend(r.get("proof_blobs") or [])
+                    # a relay reports a failed child's WHOLE subtree
+                    # absent; expand only the topmost node of each absent
+                    # subtree — its children's re-dispatch covers the
+                    # descendants, and expanding those too would dial the
+                    # same indices twice and double-count their partials
+                    abs_set = set(r["absent"])
+                    failed |= abs_set
+                    for nm in abs_set:
+                        j = idx_of[nm]
+                        p = topo.parent(j, b)
+                        if p is None or order[p] not in abs_set:
+                            expand(j)
+                elif isinstance(err, RemoteError):
+                    raise err   # the handler ran and errored: a real bug,
+                                # not an availability fault — don't degrade
+                elif isinstance(err, (TransportError, OSError)):
+                    log.warn(f"{self.name}: DP subtree {e.name} unavailable "
+                             f"for survey {dp_frame['survey_id']}: {err}")
+                    failed.add(e.name)
+                    expand(i)
+                else:
+                    raise err
+            wave = nxt
+        # a subtree member that answered a re-dispatch is not absent
+        failed -= got
+        responders = [nm for nm in order if nm in got]
+        return partials, responders, sorted(failed), blobs
+
     # -- root CN: the whole survey (reference HandleSurveyQuery +
     # StartService phase order, service.go:263-747)
     def _h_survey_query(self, msg: dict) -> dict:
@@ -641,9 +906,10 @@ class DrynxNode:
         # pre-resilience semantics
         min_q = int(msg.get("min_dp_quorum") or 0)
         need = min_q if min_q > 0 else len(dps)
+        mode = topo.topology_mode()
         log.lvl1(f"{self.name}: survey {survey_id} op={op} "
                  f"dps={len(dps)} cns={len(cns)} proofs={int(proofs)} "
-                 f"quorum={need}")
+                 f"quorum={need} topology={mode}")
 
         # range-signature setup: every CN publishes its BB digit signatures
         # for each distinct base u in the query's ranges
@@ -662,8 +928,10 @@ class DrynxNode:
                 range_sigs_msg[str(u)] = {"pubs": pubs,
                                           "A": pack_array(np.stack(As))}
 
-        # collect encrypted DP responses (star topology); DPs fire range
-        # proofs at the VNs from their own processes
+        # collect encrypted DP responses — tree overlay by default (relays
+        # fold their subtrees, range proofs ride the hops as batched
+        # blobs); DRYNX_TOPOLOGY=star restores the flat fan-out where DPs
+        # fire range proofs at the VNs from their own processes
         range_offset = int(msg.get("range_offset", 0))
         dp_frame = {"type": "survey_dp", "op": op,
                     "survey_id": survey_id,
@@ -674,23 +942,28 @@ class DrynxNode:
                     "range_offset": range_offset,
                     "proofs": proofs, "ranges": ranges_v,
                     "range_sigs": range_sigs_msg}
-        outs = fan_out(dps, lambda e: dict(dp_frame), policy=self.policy)
-        cts = []
-        responders: list[str] = []
-        failed: list[str] = []
-        for e, (r, err) in zip(dps, outs):
-            if err is None:
-                responders.append(e.name)
-                cts.append(unpack_array(r["cts"]))
-            elif isinstance(err, RemoteError):
-                raise err   # the DP's handler ran and errored: a real bug,
-                            # not an availability fault — don't degrade
-            elif isinstance(err, (TransportError, OSError)):
-                log.warn(f"{self.name}: DP {e.name} unavailable for survey "
-                         f"{survey_id}: {err}")
-                failed.append(e.name)
-            else:
-                raise err
+        blobs: list[dict] = []
+        if mode == "tree" and len(dps) > 1:
+            (partials, responders,
+             failed, blobs) = self._dispatch_tree(dps, dp_frame, proofs)
+        else:
+            outs = fan_out(dps, lambda e: dict(dp_frame),
+                           policy=self.policy)
+            partials = []
+            responders, failed = [], []
+            for e, (r, err) in zip(dps, outs):
+                if err is None:
+                    responders.append(e.name)
+                    partials.append(unpack_array(r["cts"]))
+                elif isinstance(err, RemoteError):
+                    raise err   # the DP's handler ran and errored: a real
+                                # bug, not an availability fault
+                elif isinstance(err, (TransportError, OSError)):
+                    log.warn(f"{self.name}: DP {e.name} unavailable for "
+                             f"survey {survey_id}: {err}")
+                    failed.append(e.name)
+                else:
+                    raise err
         if len(responders) < need:
             raise RuntimeError(
                 f"survey {survey_id}: only {len(responders)}/{len(dps)} DPs "
@@ -714,12 +987,21 @@ class DrynxNode:
                              f"{v.name}: {err}")
                 elif err is not None:
                     raise err
-        cts = jnp.asarray(np.stack(cts))              # (n_responders, V, 2,3,16)
-        agg = B.tree_reduce_add(cts, B.ct_add)
+        # canonical fold (topology.fold_cts) in BOTH modes: tree partials
+        # and star payloads land on identical aggregate bytes, which is
+        # what makes the final transcripts byte-comparable across
+        # topologies (ISSUE 11 acceptance gate)
+        cts = jnp.asarray(np.stack(partials))  # (n_partials, V, 2, 3, 16)
+        agg = topo.fold_cts(cts)
         if proofs:
             self._send_proof_async(
                 "aggregation", survey_id, f"agg-{self.name}",
                 pickle.dumps(agg_proof.create_aggregation_proof(cts, agg)))
+            if blobs:
+                # tree mode: the DPs' range proofs were carried up the
+                # relay hops instead of fired at the VNs per-DP — deliver
+                # the whole survey's worth as one batch per VN
+                self._send_proof_batch_async(survey_id, blobs)
 
         # obfuscation chain over the CNs (zero/nonzero-semantics ops).
         # This round (and the DRO shuffle below) is a CHAIN, not a star:
@@ -830,15 +1112,34 @@ class DrynxNode:
                  f"absent DPs {msg.get('absent')}")
         return {"ok": True}
 
-    def _h_proof_request(self, msg: dict) -> dict:
-        req = rq.ProofRequest(
-            proof_type=msg["proof_type"], survey_id=msg["survey_id"],
-            sender_id=msg["sender_id"], differ_info=msg["differ_info"],
-            round_id=msg["round_id"], data=unpack_array(msg["data"]).tobytes(),
+    @staticmethod
+    def _req_of_blob(p: dict) -> rq.ProofRequest:
+        return rq.ProofRequest(
+            proof_type=p["proof_type"], survey_id=p["survey_id"],
+            sender_id=p["sender_id"], differ_info=p["differ_info"],
+            round_id=p["round_id"], data=unpack_array(p["data"]).tobytes(),
             signature=schnorr.Signature.from_bytes(
-                unpack_array(msg["signature"]).tobytes()))
-        code = self.vn.receive_proof(req)
+                unpack_array(p["signature"]).tobytes()))
+
+    def _h_proof_request(self, msg: dict) -> dict:
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN")
+        code = self.vn.receive_proof(self._req_of_blob(msg))
         return {"code": code}
+
+    def _h_proof_batch(self, msg: dict) -> dict:
+        """A whole survey's worth of relayed proof blobs in ONE frame —
+        tree mode's replacement for per-DP proof_request fan-in. Each blob
+        is received exactly as _h_proof_request would, in the frame's
+        deterministic (differ-sorted) order, so the VN's bitmap keys,
+        verdict codes and proofdb contents are identical to star's."""
+        if self.vn is None:
+            raise RuntimeError(f"node {self.name} is not a VN")
+        codes = {}
+        for p in msg["proofs"]:
+            codes[p["differ_info"]] = self.vn.receive_proof(
+                self._req_of_blob(p))
+        return {"codes": codes}
 
     def _h_vn_bitmap(self, msg: dict) -> dict:
         if self.vn is None:
@@ -847,6 +1148,8 @@ class DrynxNode:
         state = self.vn.surveys.get(sid)
         if state is None:
             raise RuntimeError(f"unknown survey {sid!r} at VN {self.name}")
+        if msg.get("vn_order") is not None:
+            return self._h_vn_bitmap_relay(msg, state)
         if msg.get("wait"):
             # block until this VN's expected-proof counter drains
             if not state.done.wait(float(msg.get("timeout",
@@ -856,6 +1159,70 @@ class DrynxNode:
                     f"proofs received for {sid!r}")
         return {"bitmap": self.vn.bitmap_for(sid),
                 "expected": state.expected}
+
+    def _h_vn_bitmap_relay(self, msg: dict, state) -> dict:
+        """Tree-overlay bitmap collection (frames carrying vn_order): wait
+        out this VN's own counter CONCURRENTLY with the child subtrees'
+        waits, then merge upward. Reports carry only COMPLETE bitmaps;
+        anything short lands in failures, so the root applies its quorum
+        to exactly the same evidence the star poll would gather."""
+        sid = msg["survey_id"]
+        timeout = float(msg.get("timeout", rp.VERIFY_WAIT_S))
+        order = list(msg["vn_order"])
+        n, b = len(order), int(msg["fanout"])
+        kids = topo.children(int(msg["index"]), n, b)
+        reports: dict[str, dict] = {}
+        failures: dict[str, str] = {}
+
+        def poll_children():
+            set_current_node(self.name)
+            by_name = {e.name: e for e in self.roster.entries}
+            idx_of = {order[c]: c for c in kids}
+            entries = [by_name[order[c]] for c in kids]
+
+            def mk(e):
+                m = dict(msg)
+                m["index"] = idx_of[e.name]
+                return m
+
+            # socket budget must outlive the child's own blocking wait
+            outs = fan_out(entries, mk,
+                           call=lambda e, m: call_entry(
+                               e, m,
+                               timeout=timeout + rp.STRAGGLER_GRACE_S,
+                               policy=self.policy))
+            for e, (r, err) in zip(entries, outs):
+                if err is None:
+                    reports.update(r["reports"])
+                    failures.update(r["failures"])
+                else:
+                    for j in topo.subtree(idx_of[e.name], n, b):
+                        failures[order[j]] = repr(err)
+
+        t = None
+        if kids:
+            t = threading.Thread(target=poll_children, daemon=True)
+            t.start()
+        own_err = None
+        try:
+            if not state.done.wait(timeout):
+                raise TimeoutError(
+                    f"VN {self.name}: {len(state.bitmap)}/{state.expected} "
+                    f"proofs received for {sid!r}")
+            bm = self.vn.bitmap_for(sid)
+            if len(bm) < state.expected:
+                raise RuntimeError(
+                    f"VN {self.name} reports {len(bm)}/{state.expected} "
+                    f"proofs for {sid!r}; refusing to commit it")
+        except Exception as e:
+            own_err = repr(e)
+        if t is not None:
+            t.join()
+        if own_err is None:
+            reports[self.name] = {"bitmap": bm, "expected": state.expected}
+        else:
+            failures[self.name] = own_err
+        return {"reports": reports, "failures": failures}
 
     def _h_end_verification(self, msg: dict) -> dict:
         """Root VN: counter-gated bitmap merge + audit-block commit.
@@ -886,55 +1253,72 @@ class DrynxNode:
         # which a bare ceil would round to "all 3 VNs"
         need = max(1, math.ceil(quorum * len(vns) - 1e-9))
 
-        lock = threading.Lock()
-        reports: dict[str, dict] = {}
-        failures: dict[str, str] = {}
-        settled = threading.Event()
+        b = topo.tree_fanout(len(vns))
+        if (topo.topology_mode() == "tree" and quorum >= 1.0
+                and len(vns) > b):
+            # full-quorum collection rides the VN tree: every bitmap is
+            # needed anyway, so there is no early-settle semantics to
+            # preserve, and relay hops merge sub-polls instead of this
+            # root holding one blocked socket per VN. Sub-1.0 quorums
+            # keep the concurrent star poll — its commit-as-soon-as-met
+            # early exit is the point of a quorum.
+            snap, fails = self._collect_bitmaps_tree(survey_id, vns,
+                                                     timeout, state, b)
+        else:
+            lock = threading.Lock()
+            reports: dict[str, dict] = {}
+            failures: dict[str, str] = {}
+            settled = threading.Event()
 
-        def note(name: str, bitmap=None, err=None):
+            def note(name: str, bitmap=None, err=None):
+                with lock:
+                    if err is None:
+                        reports[name] = bitmap
+                    else:
+                        failures[name] = err
+                    if (len(reports) >= need
+                            or len(reports) + len(failures) >= len(vns)):
+                        settled.set()
+
+            def poll(e):
+                set_current_node(self.name)
+                try:
+                    if e.name == self.name:
+                        if not state.done.wait(timeout):
+                            raise TimeoutError(
+                                f"VN {self.name}: {len(state.bitmap)}/"
+                                f"{state.expected} proofs received for "
+                                f"{survey_id!r}")
+                        bm, expected = (self.vn.bitmap_for(survey_id),
+                                        state.expected)
+                    else:
+                        # socket timeout must outlive the peer's wait
+                        r = call_entry(e, {"type": "vn_bitmap",
+                                           "survey_id": survey_id,
+                                           "wait": True,
+                                           "timeout": timeout},
+                                       timeout=timeout
+                                       + rp.STRAGGLER_GRACE_S,
+                                       policy=self.policy)
+                        bm, expected = r["bitmap"], r["expected"]
+                    if len(bm) < expected:
+                        raise RuntimeError(
+                            f"VN {e.name} reports {len(bm)}/{expected} "
+                            f"proofs for {survey_id!r}; refusing to "
+                            f"commit it")
+                    note(e.name, bitmap=bm)
+                except Exception as err:
+                    note(e.name, err=repr(err))
+
+            threads = [threading.Thread(target=poll, args=(e,),
+                                        daemon=True)
+                       for e in vns]
+            for t in threads:
+                t.start()
+            settled.wait(timeout + 2 * rp.STRAGGLER_GRACE_S)
             with lock:
-                if err is None:
-                    reports[name] = bitmap
-                else:
-                    failures[name] = err
-                if (len(reports) >= need
-                        or len(reports) + len(failures) >= len(vns)):
-                    settled.set()
-
-        def poll(e):
-            try:
-                if e.name == self.name:
-                    if not state.done.wait(timeout):
-                        raise TimeoutError(
-                            f"VN {self.name}: {len(state.bitmap)}/"
-                            f"{state.expected} proofs received for "
-                            f"{survey_id!r}")
-                    bm, expected = (self.vn.bitmap_for(survey_id),
-                                    state.expected)
-                else:
-                    # socket timeout must outlive the peer's blocking wait
-                    r = call_entry(e, {"type": "vn_bitmap",
-                                       "survey_id": survey_id,
-                                       "wait": True, "timeout": timeout},
-                                   timeout=timeout + rp.STRAGGLER_GRACE_S,
-                                   policy=self.policy)
-                    bm, expected = r["bitmap"], r["expected"]
-                if len(bm) < expected:
-                    raise RuntimeError(
-                        f"VN {e.name} reports {len(bm)}/{expected} proofs "
-                        f"for {survey_id!r}; refusing to commit it")
-                note(e.name, bitmap=bm)
-            except Exception as err:
-                note(e.name, err=repr(err))
-
-        threads = [threading.Thread(target=poll, args=(e,), daemon=True)
-                   for e in vns]
-        for t in threads:
-            t.start()
-        settled.wait(timeout + 2 * rp.STRAGGLER_GRACE_S)
-        with lock:
-            snap = dict(reports)
-            fails = dict(failures)
+                snap = dict(reports)
+                fails = dict(failures)
         if len(snap) < need:
             raise TimeoutError(
                 f"root VN {self.name}: {len(snap)}/{len(vns)} VNs report "
@@ -955,6 +1339,65 @@ class DrynxNode:
                 "bitmap": merged, "vn_reported": reported,
                 "vn_absent": absent}
 
+    def _collect_bitmaps_tree(self, sid: str, vns, timeout: float,
+                              state, b: int):
+        """Tree-overlay VN bitmap collection (full-quorum mode): this root
+        VN walks its own subtree inline while the OTHER forest roots are
+        polled concurrently; each relay hop merges complete bitmaps and
+        failures upward. Returns (snap {name: bitmap}, fails)."""
+        order = [e.name for e in vns]
+        n = len(order)
+        base = {"type": "vn_bitmap", "survey_id": sid, "wait": True,
+                "timeout": timeout, "vn_order": order, "fanout": b}
+        tops = topo.roots(n, b)
+        i0 = order.index(self.name) if self.name in order else -1
+        remote = [i for i in tops if i != i0]
+        reports: dict[str, dict] = {}
+        failures: dict[str, str] = {}
+        r_out: list = []
+
+        def run_remote():
+            set_current_node(self.name)
+            entries = [vns[i] for i in remote]
+            iix = {order[i]: i for i in remote}
+
+            def mk(e):
+                m = dict(base)
+                m["index"] = iix[e.name]
+                return m
+
+            # two grace units: the remote relay's own sockets already
+            # carry one on top of the blocking wait they wrap
+            outs = fan_out(entries, mk,
+                           call=lambda e, m: call_entry(
+                               e, m,
+                               timeout=timeout
+                               + 2 * rp.STRAGGLER_GRACE_S,
+                               policy=self.policy))
+            r_out.append((entries, iix, outs))
+
+        t = None
+        if remote:
+            t = threading.Thread(target=run_remote, daemon=True)
+            t.start()
+        if i0 in tops:
+            # walk our own subtree inline; a non-root self is instead
+            # polled over TCP by its tree parent like any other VN
+            own = self._h_vn_bitmap_relay(dict(base, index=i0), state)
+            reports.update(own["reports"])
+            failures.update(own["failures"])
+        if t is not None:
+            t.join()
+        for entries, iix, outs in r_out:
+            for e, (r, err) in zip(entries, outs):
+                if err is None:
+                    reports.update(r["reports"])
+                    failures.update(r["failures"])
+                else:
+                    for j in topo.subtree(iix[e.name], n, b):
+                        failures[order[j]] = repr(err)
+        snap = {nm: rep["bitmap"] for nm, rep in reports.items()}
+        return snap, failures
 
     # -- VN skipchain retrieval handlers (reference
     # services/service_skipchain.go:173-342: HandleGetGenesisBlock :173,
@@ -1065,9 +1508,11 @@ class RemoteClient:
     def expected_proofs(self, n_dps: int, n_cns: int, obfuscation: bool,
                         diffp: bool) -> int:
         """Proof count every VN must receive for one survey over the TCP
-        path: range per DP, ONE aggregation (the root aggregates the whole
-        star — unlike the in-process tree there is exactly one aggregator),
-        keyswitch per CN, obfuscation/shuffle per CN when enabled."""
+        path: range per DP, ONE aggregation (whatever the dispatch
+        topology, exactly one VN-visible aggregation proof comes from the
+        root — tree relays' per-hop proofs are verified by their PARENT,
+        never delivered to VNs), keyswitch per CN, obfuscation/shuffle per
+        CN when enabled."""
         return (n_dps + 1 + n_cns + (n_cns if obfuscation else 0)
                 + (n_cns if diffp else 0))
 

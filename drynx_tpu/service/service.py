@@ -47,6 +47,7 @@ from ..resilience import faults
 from ..resilience import policy as rp
 from ..utils import log
 from ..utils.timers import PhaseTimers
+from . import topology as topo
 from .proof_collection import VerifyCache, VerifyingNode, VNGroup
 from .query import (DiffPParams, Operation, Query, SurveyQuery,
                     check_parameters, choose_operation, query_to_proofs_nbrs)
@@ -701,8 +702,11 @@ class LocalCluster:
 
         # --- Aggregation phase (reference AggregationPhase :775) --------
         tm.start("AggregationPhase")
-        agg = f_agg(cts)
-        agg.block_until_ready()
+        # canonical aggregate (topology.canon_points): the in-process
+        # plane lands on the same aggregate BYTES as the remote tree/star
+        # dispatch paths, which all fold through topology.fold_cts
+        agg = topo.canon_points(f_agg(cts))
+        jax.block_until_ready(agg)
         tm.end("AggregationPhase")
         if proofs_on:
             # each CN signs its own request but the (transparent) proof body

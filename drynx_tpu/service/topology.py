@@ -1,0 +1,153 @@
+"""Deterministic roster-derived tree overlay + canonical ciphertext folds.
+
+The reference's 20-machine deployment aggregates *up a tree* (SURVEY §L3:
+collective aggregation as an onet tree protocol), while our control plane
+dispatched every round as a flat star from the root CN — O(n) fan-in at
+one socket endpoint. This module derives a tree purely from the dialed
+roster order, so every process (root, relay, client, bench) computes the
+identical overlay with zero coordination messages:
+
+  fanout b   = DRYNX_TREE_FANOUT, else clamp(ceil(sqrt(n)),
+               TREE_FANOUT_MIN, TREE_FANOUT_MAX)  (depth/width balance)
+  roots      = indices [0, b)                      (a forest of b trees)
+  children(i)= [(i+1)*b, (i+2)*b) intersect [0, n)
+  parent(j)  = j // b - 1                          (for j >= b)
+
+The layout is breadth-first over the *dialed index space*, not over any
+contiguous value range: a subtree's members are scattered through the
+roster, so correctness of folding rests on the ciphertext group being
+abelian — any grouping of the mod-p point additions yields the same group
+element. Identical *bytes*, however, need one more step: Jacobian points
+carry projective slack (the same group element has many (X, Y, Z) limb
+representations, and XLA's tree_reduce_add produces different Z's under
+different fold shapes). :func:`canon_points` erases that slack by
+normalizing every point to its unique affine-with-z=1 Montgomery form
+(infinity pinned to (1, 1, 0)), so canon(fold(any grouping)) is
+byte-identical — the "mod-p associativity" contract the tree/star
+transcript-identity gate rests on (tests/test_topology.py proves it).
+
+DRYNX_TOPOLOGY=star is the kill-switch back to flat fan-out.
+
+Pure-python layout half: no jax import at module scope — chaos tooling
+and the jax-free bench supervisor import this for tree math.
+"""
+from __future__ import annotations
+
+import math
+import os
+
+from ..resilience import policy as rp
+
+ENV_TOPOLOGY = "DRYNX_TOPOLOGY"
+ENV_FANOUT = "DRYNX_TREE_FANOUT"
+
+
+def topology_mode() -> str:
+    """"tree" (default) or "star" (the DRYNX_TOPOLOGY=star kill-switch).
+    Unrecognized values fall back to tree so a typo degrades to the
+    default instead of silently inventing a third mode."""
+    if os.environ.get(ENV_TOPOLOGY, "").strip().lower() == "star":
+        return "star"
+    return "tree"
+
+
+def tree_fanout(n: int) -> int:
+    """Branching factor for an n-entry roster. DRYNX_TREE_FANOUT
+    overrides; auto is ceil(sqrt(n)) clamped to the policy bounds —
+    sqrt balances tree depth against per-relay fan-in, the cap keeps one
+    relay's concurrent child RPCs in FAN_OUT_WORKERS territory."""
+    env = os.environ.get(ENV_FANOUT, "").strip()
+    if env:
+        return max(1, int(env))
+    if n <= 1:
+        return 1
+    auto = math.ceil(math.sqrt(n))
+    return max(rp.TREE_FANOUT_MIN, min(auto, rp.TREE_FANOUT_MAX))
+
+
+def roots(n: int, b: int) -> list[int]:
+    """Top-level indices the dispatching root contacts directly."""
+    return list(range(min(b, n)))
+
+
+def children(i: int, n: int, b: int) -> list[int]:
+    """Direct children of index i in the breadth-first overlay."""
+    lo, hi = (i + 1) * b, (i + 2) * b
+    return list(range(min(lo, n), min(hi, n)))
+
+
+def parent(i: int, b: int):
+    """Parent index of i, or None for the forest roots [0, b)."""
+    return None if i < b else i // b - 1
+
+
+def subtree(i: int, n: int, b: int) -> list[int]:
+    """Every index in the subtree rooted at i (preorder, i first)."""
+    out, stack = [], [i]
+    while stack:
+        j = stack.pop()
+        out.append(j)
+        stack.extend(reversed(children(j, n, b)))
+    return out
+
+
+def depth(n: int, b: int) -> int:
+    """Number of levels in the overlay (1 = pure star of roots)."""
+    d, level = 0, list(range(min(b, n)))
+    while level:
+        d += 1
+        level = [c for i in level for c in children(i, n, b)]
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Canonical folds (jax imported lazily: the layout half must work in
+# jax-free processes — bench supervisor parents, chaos tooling)
+# ---------------------------------------------------------------------------
+
+def canon_points(a):
+    """Rewrite a tensor of Jacobian points (..., 3, 16 uint32 limbs) to
+    the canonical representative of each group element: affine limbs with
+    Z = 1 in Montgomery form, infinity pinned to (1, 1, 0). Idempotent,
+    and collapses all projective representations of one element to the
+    same bytes — the property every byte-identity gate (tree vs star,
+    serial vs parallel) folds through. As a side effect the Z plane
+    becomes a constant, which the wire's lossless integer narrowing
+    compresses, so canonical relay payloads are also *smaller*."""
+    import jax.numpy as jnp
+
+    from ..crypto import batching as B
+    from ..crypto.field import FP
+
+    a = jnp.asarray(a)
+    sh = a.shape
+    pts = a.reshape((-1, 3, 16))
+    xx, yy, inf = B.g1_normalize(pts)
+    one = jnp.broadcast_to(jnp.asarray(FP.one_mont, dtype=jnp.uint32),
+                           xx.shape)
+    zero = jnp.zeros_like(xx)
+    m = inf[..., None]
+    out = jnp.stack([jnp.where(m, one, xx), jnp.where(m, one, yy),
+                     jnp.where(m, zero, one)], axis=-2)
+    return out.reshape(sh).astype(jnp.uint32)
+
+
+def fold_cts(stack):
+    """Homomorphic fold of stacked ciphertexts (k, V, 2, 3, 16) into one
+    canonical (V, 2, 3, 16) sum. Relays fold their subtree with this,
+    the root folds relay partials, and the star path folds all n DP
+    payloads — same helper everywhere, so any dispatch topology lands on
+    the same aggregate bytes."""
+    import jax.numpy as jnp
+
+    from ..crypto import batching as B
+
+    cts = jnp.asarray(stack)
+    acc = cts[0] if int(cts.shape[0]) == 1 \
+        else B.tree_reduce_add(cts, B.ct_add)
+    return canon_points(acc)
+
+
+__all__ = ["topology_mode", "tree_fanout", "roots", "children", "parent",
+           "subtree", "depth", "canon_points", "fold_cts",
+           "ENV_TOPOLOGY", "ENV_FANOUT"]

@@ -108,6 +108,15 @@ class LinkModel:
     fan_out workers charge concurrently — but the emulation sleep happens
     OUTSIDE the lock, so concurrent sends overlap their link time exactly
     like independent physical links would.
+
+    ``rx_by_node`` is the receive-side ledger the tree plane needs: every
+    frame is charged once at the SENDER (delay + bandwidth + totals), and
+    counted once more — accounting only, no second sleep — against the
+    node whose process RECEIVED it (count_rx). bytes-at-root, the number
+    the tree topology exists to shrink, is rx_by_node[root] (relay-hop
+    traffic lands on the relays instead). Empty until a tree/relay-aware
+    caller labels receives, and omitted from stats() while empty so
+    pre-tree consumers see the exact legacy shape.
     """
 
     def __init__(self, delay_ms: float = 0.0, bandwidth_mbps: float = 0.0):
@@ -118,6 +127,7 @@ class LinkModel:
         self.bytes_total = 0
         self.msgs_total = 0
         self.by_peer: dict[str, int] = {}
+        self.rx_by_node: dict[str, int] = {}
 
     @property
     def active(self) -> bool:
@@ -133,17 +143,29 @@ class LinkModel:
         if t > 0:
             time.sleep(t)
 
+    def count_rx(self, n_bytes: int, node: str) -> None:
+        """Attribute received bytes to the consuming node. Pure
+        accounting: the frame already paid its link time at the sender."""
+        if not node:
+            return
+        with self._lock:
+            self.rx_by_node[node] = self.rx_by_node.get(node, 0) + n_bytes
+
     def stats(self) -> dict:
         with self._lock:
-            return {"bytes_total": self.bytes_total,
-                    "msgs_total": self.msgs_total,
-                    "by_peer": dict(self.by_peer)}
+            out = {"bytes_total": self.bytes_total,
+                   "msgs_total": self.msgs_total,
+                   "by_peer": dict(self.by_peer)}
+            if self.rx_by_node:
+                out["rx_by_node"] = dict(self.rx_by_node)
+            return out
 
     def reset_stats(self) -> None:
         with self._lock:
             self.bytes_total = 0
             self.msgs_total = 0
             self.by_peer = {}
+            self.rx_by_node = {}
 
     @classmethod
     def from_env(cls) -> "LinkModel":
@@ -153,6 +175,22 @@ class LinkModel:
 
 
 _LINK: Optional[LinkModel] = None
+
+# Ambient per-thread node identity for receive-side accounting: a relay's
+# OUTBOUND calls happen on handler/worker threads, far from any object
+# that knows which node is talking. NodeServer.handle pins the serving
+# node's name on its connection thread; fan_out / proof-delivery /
+# poll threads must re-pin it on their workers (ThreadPoolExecutor
+# threads inherit nothing). Unset means "client" — the querier process.
+_CURRENT_NODE = threading.local()
+
+
+def set_current_node(name: str) -> None:
+    _CURRENT_NODE.name = name
+
+
+def current_node() -> str:
+    return getattr(_CURRENT_NODE, "name", "")
 
 
 def link_model() -> LinkModel:
@@ -374,10 +412,13 @@ def send_frame(sock: socket.socket, obj: dict, wire: int = 1,
 
 
 def recv_frame(sock: socket.socket, wire: int = 1,
-               max_bytes: Optional[int] = None) -> Optional[dict]:
+               max_bytes: Optional[int] = None,
+               rx_node: str = "") -> Optional[dict]:
     """One frame, or None on clean EOF. Raises :class:`FrameTooLarge`
     before allocating anything for an oversized header and
-    :class:`CorruptFrame` when the body doesn't decode under ``wire``."""
+    :class:`CorruptFrame` when the body doesn't decode under ``wire``.
+    ``rx_node`` attributes the received bytes to a node in the LinkModel's
+    rx ledger (relay-hop accounting; "" skips it)."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
@@ -390,6 +431,8 @@ def recv_frame(sock: socket.socket, wire: int = 1,
     body = _recv_exact(sock, n)
     if body is None:
         return None
+    if rx_node:
+        link_model().count_rx(4 + n, rx_node)
     return decode_frame(body, wire)
 
 
@@ -470,13 +513,17 @@ class NodeServer:
         class _H(socketserver.BaseRequestHandler):
             def handle(self):
                 wire = 1
+                # handlers dial OTHER nodes from this thread (relay hops,
+                # proof fan-out): pin the serving node's identity so their
+                # received replies land on this node's rx ledger
+                set_current_node(outer.node_name)
                 while True:
                     plan = faults.fault_plan()
                     name = outer.node_name
                     if plan is not None and name and plan.killed(name):
                         return           # dead node: close without a word
                     try:
-                        msg = recv_frame(self.request, wire)
+                        msg = recv_frame(self.request, wire, rx_node=name)
                     except TransportError:
                         # oversized/corrupt framing is unrecoverable on a
                         # stream transport: drop the connection, the peer
@@ -588,7 +635,8 @@ class Conn:
             try:
                 send_frame(self.sock, {"type": "wire_hello", "max": want},
                            1, peer=self.peer)
-                reply = recv_frame(self.sock, 1)
+                reply = recv_frame(self.sock, 1,
+                                   rx_node=current_node() or "client")
                 if (reply is not None and reply.get("type") != "error"
                         and int(reply.get("wire", 1)) >= 2):
                     self.wire = 2
@@ -624,7 +672,8 @@ class Conn:
                 else:
                     send_frame(self.sock, obj, self.wire, peer=self.peer)
                     self.sent = True
-                reply = recv_frame(self.sock, self.wire)
+                reply = recv_frame(self.sock, self.wire,
+                                   rx_node=current_node() or "client")
             except ConnectionClosed:
                 raise
             except socket.timeout as e:
@@ -677,19 +726,35 @@ class ConnPool:
     connections are closed, keeping the fd footprint at
     len(roster) * max_idle.
 
+    ``max_total`` bounds idle sockets across ALL keys: at a 256-DP
+    roster the per-key bound alone still means hundreds of live fds in
+    the root process. When a put would exceed it, the least-recently-
+    used idle connection (whatever its peer) is closed first — warm
+    peers keep their sockets, cold peers age out. rp.CONN_POOL_MAX
+    defaults it generously; DRYNX_CONN_POOL_MAX overrides per process.
+
     The FaultPlan ``connect`` hook fires only on real (re)connects —
     reuse never consults it, which keeps seeded chaos schedules
     independent of pool hit rates (faults.py keys draws per node, not by
     global arrival order).
     """
 
-    def __init__(self, max_idle: int = rp.CONN_POOL_MAX_IDLE):
+    def __init__(self, max_idle: int = rp.CONN_POOL_MAX_IDLE,
+                 max_total: Optional[int] = None):
         self.max_idle = int(max_idle)
+        if max_total is None:
+            env = os.environ.get("DRYNX_CONN_POOL_MAX", "").strip()
+            max_total = int(env) if env else rp.CONN_POOL_MAX
+        self.max_total = int(max_total)
         self._lock = threading.Lock()
-        self._idle: dict[tuple, list[Conn]] = {}
+        # stacks hold (stamp, Conn); LIFO per key keeps the warmest
+        # socket on top, the monotonic stamp orders LRU eviction globally
+        self._idle: dict[tuple, list[tuple[int, Conn]]] = {}
+        self._stamp = 0
         self.connects = 0
         self.reuses = 0
         self.discards = 0
+        self.evictions = 0
 
     @staticmethod
     def _key(conn: Conn) -> tuple:
@@ -701,7 +766,7 @@ class ConnPool:
         while True:
             with self._lock:
                 stack = self._idle.get(key)
-                conn = stack.pop() if stack else None
+                conn = stack.pop()[1] if stack else None
             if conn is None:
                 break
             if self._healthy(conn, timeout):
@@ -738,12 +803,46 @@ class ConnPool:
             self.discard(conn)
             return
         key = self._key(conn)
+        evicted: list[Conn] = []
+        pooled = False
         with self._lock:
-            stack = self._idle.setdefault(key, [])
-            if len(stack) < self.max_idle:
-                stack.append(conn)
-                return
-        self.discard(conn)
+            if len(self._idle.get(key, ())) < self.max_idle:
+                while (sum(len(s) for s in self._idle.values())
+                       >= self.max_total):
+                    victim = self._pop_lru_locked()
+                    if victim is None:
+                        break
+                    evicted.append(victim)
+                    self.evictions += 1
+                self._stamp += 1
+                # (re)fetch after eviction: popping this key's last idle
+                # conn deletes its stack, and appending to the orphaned
+                # list would leak the socket out of the pool
+                self._idle.setdefault(key, []).append((self._stamp, conn))
+                pooled = True
+        for v in evicted:
+            try:
+                v.sock.close()
+            except OSError:
+                pass
+            v.closed = True
+        if not pooled:
+            self.discard(conn)
+
+    def _pop_lru_locked(self) -> Optional[Conn]:
+        """Remove and return the globally least-recently-pooled idle
+        connection (caller holds the lock). Oldest stamp sits at each
+        stack's base, so the scan is O(#keys)."""
+        best_key, best_stamp = None, None
+        for key, stack in self._idle.items():
+            if stack and (best_stamp is None or stack[0][0] < best_stamp):
+                best_key, best_stamp = key, stack[0][0]
+        if best_key is None:
+            return None
+        conn = self._idle[best_key].pop(0)[1]
+        if not self._idle[best_key]:
+            del self._idle[best_key]
+        return conn
 
     def discard(self, conn: Optional[Conn]) -> None:
         if conn is None:
@@ -760,7 +859,7 @@ class ConnPool:
         with self._lock:
             idle, self._idle = self._idle, {}
         for stack in idle.values():
-            for conn in stack:
+            for _stamp, conn in stack:
                 try:
                     conn.sock.close()
                 except OSError:
@@ -775,6 +874,7 @@ class ConnPool:
         with self._lock:
             return {"connects": self.connects, "reuses": self.reuses,
                     "discards": self.discards,
+                    "evictions": self.evictions,
                     "idle": sum(len(s) for s in self._idle.values())}
 
 
@@ -873,6 +973,6 @@ __all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
            "NodeServer", "Conn", "ConnPool", "conn_pool", "set_conn_pool",
            "pool_enabled", "LinkModel", "link_model",
            "set_link_model", "set_max_frame_bytes", "MAX_FRAME_BYTES",
-           "local_call",
+           "local_call", "set_current_node", "current_node",
            "TransportError", "ConnectError", "ConnectionClosed",
            "CallTimeout", "FrameTooLarge", "CorruptFrame", "RemoteError"]

@@ -335,6 +335,39 @@ def test_hardcoded_timeout_allows_named_network_plane_knobs():
     assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
 
 
+def test_hardcoded_timeout_covers_tree_overlay_knobs():
+    src = """
+        import os
+
+        def dispatch(order, fanout=8):
+            b = plan_tree(tree_fanout=4)
+            cap = int(os.environ.get("DRYNX_CONN_POOL_MAX", 1024))
+            pool = make_pool(pool_max=256)
+    """
+    found = run(src, relpath=SERVICE, rule="hardcoded-timeout")
+    assert len(found) == 4
+    texts = " ".join(f.message for f in found)
+    assert "fanout=8" in texts and "tree_fanout=4" in texts
+    assert ".get('DRYNX_CONN_POOL_MAX', 1024)" in texts
+    assert "pool_max=256" in texts
+
+
+def test_hardcoded_timeout_allows_policy_backed_tree_knobs():
+    # string-typed env fallbacks (the topology.py / transport.py idiom)
+    # and policy constants stay clean
+    src = """
+        import os
+        from drynx_tpu.resilience import policy as rp
+
+        def dispatch(order, fanout=None):
+            raw = os.environ.get("DRYNX_TREE_FANOUT", "").strip()
+            mode = os.environ.get("DRYNX_TOPOLOGY", "tree")
+            b = clamp(int(raw or 0), rp.TREE_FANOUT_MIN, rp.TREE_FANOUT_MAX)
+            pool = make_pool(pool_max=rp.CONN_POOL_MAX)
+    """
+    assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
+
+
 # -- suppression + baseline mechanics ---------------------------------------
 
 def test_noqa_suppresses_named_rule_only():

@@ -266,6 +266,36 @@ def test_conn_pool_reuses_and_bounds_idle():
         srv.stop()
 
 
+def test_conn_pool_global_cap_evicts_lru(monkeypatch):
+    """max_total bounds idle sockets across ALL keys: at the cap a put
+    closes the globally least-recently-pooled connection first, so a
+    256-peer roster's cold sockets age out while warm ones survive.
+    DRYNX_CONN_POOL_MAX overrides the policy default per process."""
+    s1, s2 = _echo_server(), _echo_server()
+    pool = ConnPool(max_idle=4, max_total=2)
+    try:
+        a1 = pool.get(s1.host, s1.port, peer="a")
+        a2 = pool.get(s1.host, s1.port, peer="a")
+        b1 = pool.get(s2.host, s2.port, peer="b")
+        pool.put(a1)                     # oldest stamp -> LRU victim
+        pool.put(b1)
+        assert pool.idle_count() == 2 and pool.stats()["evictions"] == 0
+        pool.put(a2)                     # at cap: a1 ages out, b1 stays
+        st = pool.stats()
+        assert st["evictions"] == 1 and st["idle"] == 2
+        assert a1.closed and not b1.closed
+        assert pool.get(s1.host, s1.port, peer="a") is a2
+        assert pool.get(s2.host, s2.port, peer="b") is b1
+        pool.close_all()
+    finally:
+        s1.stop()
+        s2.stop()
+    monkeypatch.setenv("DRYNX_CONN_POOL_MAX", "3")
+    assert ConnPool().max_total == 3
+    monkeypatch.delenv("DRYNX_CONN_POOL_MAX")
+    assert ConnPool().max_total == rp.CONN_POOL_MAX
+
+
 def test_conn_pool_never_reuses_timed_out_conn():
     """The half-read bugfix: a CallTimeout leaves the reply in flight; the
     broken conn must never be pooled, and the next checkout must get a
