@@ -469,7 +469,17 @@ class HardcodedTimeout(Rule):
     fallback in ``.get("DRYNX_CONN_POOL_MAX", 1024)`` silently forks the
     default away from policy — so both route through TREE_FANOUT_MIN/MAX
     and CONN_POOL_MAX instead (env fallbacks stay string-typed, which
-    this rule ignores by design)."""
+    this rule ignores by design).
+
+    Saturation serving (PR 12) added the admission-control family:
+    verify-worker pool width, per-tenant quotas, shed thresholds, and
+    retry-after hint bounds (workers=/quota=/shed_fraction=/
+    retry_after_*=), surfaced as the DRYNX_VERIFY_WORKERS /
+    DRYNX_TENANT_QUOTA / DRYNX_SHED_FRACTION env knobs. A literal
+    ``tenant_quota=8`` decides when a tenant starts seeing typed
+    rejections exactly like a bare timeout decides when a caller gives
+    up — the defaults live in policy.py (VERIFY_WORKERS, TENANT_QUOTA,
+    SHED_FRACTION, SHED_RETRY_MIN_S/MAX_S)."""
 
     id = "hardcoded-timeout"
     summary = ("bare numeric timeout/retry/worker-pool literal outside "
@@ -486,7 +496,12 @@ class HardcodedTimeout(Rule):
                 or n == "max_idle" or n.endswith("_idle")
                 or n == "pool_size" or n.endswith("_pool_size")
                 or n == "fanout" or n.endswith("_fanout")
-                or n == "pool_max" or n.endswith("_pool_max"))
+                or n == "pool_max" or n.endswith("_pool_max")
+                or n == "quota" or n.endswith("_quota")
+                # NB: substring "shed" would also match "finished"
+                or n == "shed" or n.startswith("shed_")
+                or n.endswith("_shed") or "shed_fraction" in n
+                or "retry_after" in n)
 
     @staticmethod
     def _nonzero_num(node: ast.AST) -> bool:

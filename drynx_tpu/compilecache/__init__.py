@@ -5,14 +5,18 @@ Public surface:
   build_registry(profile) — enumerate ProgramSpecs (no tracing)
   precompile(profile)     — serial trace/lower/compile driver
   trace_guard()           — recursion-limit + thread-stack-size guard
+  WORKER_OPS, worker_specs — the verify-worker dispatch set (the ops a
+                            server verify worker may jit-dispatch; the
+                            compile lane's execute filter on CPU)
   STATS, CompileStats     — per-program timings + persistent-cache counters
 
 CLI: python -m drynx_tpu.precompile [--dry-run]
 """
-from .registry import (BENCH, Profile, ProgramSpec, build_registry,
-                       precompile, trace_guard)
+from .registry import (BENCH, WORKER_OPS, Profile, ProgramSpec,
+                       build_registry, precompile, trace_guard,
+                       worker_specs)
 from .stats import STATS, CompileStats, install_cache_listener
 
-__all__ = ["BENCH", "Profile", "ProgramSpec", "build_registry",
-           "precompile", "trace_guard", "STATS", "CompileStats",
-           "install_cache_listener"]
+__all__ = ["BENCH", "WORKER_OPS", "Profile", "ProgramSpec",
+           "build_registry", "precompile", "trace_guard", "worker_specs",
+           "STATS", "CompileStats", "install_cache_listener"]

@@ -782,6 +782,30 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     return list(specs.values())
 
 
+# The program set a verify WORKER thread dispatches as real jits on CPU:
+# the mod-p/mod-n scalar family used by payload deserialization
+# (to_mont_p in _g1/_g2/_gt _from_bytes), the RLC weights (int_to_scalar,
+# fn_*), and the wire encoders. The g1/pairing families host-detour on
+# CPU and everything else dispatches from the drain thread. The registry
+# owns this set so the server's compile lane (which executes exactly
+# these during a lower-mode pass) and the warm-coverage test stay in
+# lockstep with the schemas above — a worker POOL of any width shares
+# the process-wide dispatch caches, so warming the set once covers every
+# worker (tests/test_precompile.py asserts the coverage).
+WORKER_OPS = frozenset({
+    "fn_add", "fn_sub", "fn_neg", "fn_mul_plain", "fn_mont_mul",
+    "int_to_scalar", "to_mont_p", "from_mont_p",
+})
+
+
+def worker_specs(profile: Profile) -> list:
+    """The registry subset a verify worker may dispatch (device-family
+    programs over WORKER_OPS) — the server's execute filter during a CPU
+    lower-mode compile pass."""
+    return [s for s in build_registry(profile)
+            if s.family == "device" and s.op in WORKER_OPS]
+
+
 # ---------------------------------------------------------------------------
 # Serial driver
 # ---------------------------------------------------------------------------

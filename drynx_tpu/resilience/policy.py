@@ -93,6 +93,46 @@ TREE_FANOUT_MAX = 8
 # proofs). Small — one entry is one survey's ciphertext payload.
 DP_REPLY_CACHE_MAX = 8
 
+# -- serving-plane knobs (PR 12) --------------------------------------------
+# Verify worker pool width (server/scheduler.py). Every worker still only
+# RE-EXECUTES warm programs (the r05 contract), so widening the pool is
+# safe by construction; 1 preserves the historical single-worker pipeline.
+# N>1 pays off when verification blocks on waits (remote VNs, proof-thread
+# joins, end_verification polling) rather than on local compute.
+# DRYNX_VERIFY_WORKERS overrides.
+VERIFY_WORKERS = 1
+# Per-tenant queue quota: how many of one tenant's surveys may be queued
+# across all lanes at once. Sized to half the default max_depth so a
+# single hot tenant can never fill the whole bounded queue — QuotaExceeded
+# is raised while other tenants still admit. DRYNX_TENANT_QUOTA overrides.
+TENANT_QUOTA = 8
+# Admission-controlled shedding: past ceil(SHED_FRACTION * max_depth)
+# total queued surveys, submit() raises Overloaded with a retry_after_s
+# hint instead of letting the queue ride to QueueFull collapse. 1.0
+# disables shedding (the depth bound alone applies — the historical
+# behavior). DRYNX_SHED_FRACTION overrides.
+SHED_FRACTION = 0.75
+# Bounds on the retry-after hint an Overloaded rejection carries: the
+# estimate is backlog / observed completion rate, clamped so a cold
+# server (no rate yet) hints the max and a fast one never hints a
+# zero-length busy-wait.
+SHED_RETRY_MIN_S = 0.05
+SHED_RETRY_MAX_S = 30.0
+# Completion events the scheduler keeps for its observed service-rate
+# window (drives both the retry-after hint and demand-aware refill).
+RATE_WINDOW_EVENTS = 64
+# Demand-aware pool refill: the refill lane deposits slabs to cover the
+# waiting survey's need PLUS the observed DRO consumption rate over this
+# horizon, at most REFILL_MAX_SLABS_STEP slabs per cooperative step (so
+# the fast and compile lanes still preempt promptly).
+REFILL_HORIZON_S = 2.0
+REFILL_MAX_SLABS_STEP = 4
+# Survey resume (ROADMAP item 6, minimal slice): how many times a
+# fast-lane entry whose dispatch failed may re-enter the queue (with
+# responders re-probed and carried over). Exactly once — a second
+# failure surfaces as the survey's error.
+RESUME_MAX_RETRIES = 1
+
 # -- idempotency table ------------------------------------------------------
 # Read-only or set-once-overwrite handlers: re-execution is harmless.
 IDEMPOTENT_MTYPES = frozenset({
@@ -165,4 +205,8 @@ __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
            "VN_GROUP_WAIT_S", "POLL_INTERVAL_S", "COLD_COMPILE_WAIT_S",
            "END_VERIFICATION_TIMEOUT_S", "SUBPROCESS_TIMEOUT_S",
            "FAN_OUT_WORKERS", "CONN_POOL_MAX_IDLE", "CONN_POOL_MAX",
-           "TREE_FANOUT_MIN", "TREE_FANOUT_MAX", "DP_REPLY_CACHE_MAX"]
+           "TREE_FANOUT_MIN", "TREE_FANOUT_MAX", "DP_REPLY_CACHE_MAX",
+           "VERIFY_WORKERS", "TENANT_QUOTA", "SHED_FRACTION",
+           "SHED_RETRY_MIN_S", "SHED_RETRY_MAX_S", "RATE_WINDOW_EVENTS",
+           "REFILL_HORIZON_S", "REFILL_MAX_SLABS_STEP",
+           "RESUME_MAX_RETRIES"]

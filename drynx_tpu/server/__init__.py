@@ -5,7 +5,7 @@ See SERVER.md for the architecture, the batching algebra, and the
 threading rules the scheduler inherits from the compilecache subsystem.
 """
 from .admission import (Admission, AdmissionController, AdmissionError,
-                        QueueFull)
+                        Overloaded, QueueFull, QuotaExceeded)
 from .scheduler import SurveyServer, pipeline_overlap, refill_overlap
 from .transcript import survey_transcript, transcript_digest
 
@@ -13,7 +13,9 @@ __all__ = [
     "Admission",
     "AdmissionController",
     "AdmissionError",
+    "Overloaded",
     "QueueFull",
+    "QuotaExceeded",
     "SurveyServer",
     "pipeline_overlap",
     "refill_overlap",

@@ -32,6 +32,29 @@ class QueueFull(AdmissionError):
     """The server's bounded queue is at max_depth; resubmit later."""
 
 
+class QuotaExceeded(AdmissionError):
+    """One tenant's queued-survey quota is exhausted. Unlike QueueFull
+    this is a PER-TENANT verdict: the rejected tenant must back off while
+    every other tenant keeps admitting — the typed half of the fair-
+    queueing contract (the DRR scheduler is the other half)."""
+
+    def __init__(self, msg: str, tenant: str = "", quota: int = 0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.quota = quota
+
+
+class Overloaded(AdmissionError):
+    """Admission-controlled shed: the queue passed the shed threshold and
+    the server rejects EARLY, with a retry-after hint derived from the
+    observed completion rate — callers back off for ``retry_after_s``
+    instead of piling onto a queue that would collapse into QueueFull."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 @dataclasses.dataclass(frozen=True)
 class Admission:
     """Triage verdict for one submitted survey."""
@@ -43,6 +66,7 @@ class Admission:
     dro_need: int = 0             # pool elements the survey's DRO phase
                                   # consumes (n_cns * noise_list_size);
                                   # 0 for non-diffp surveys
+    tenant: str = "default"       # fair-queueing lane key (DRR + quota)
 
 
 class AdmissionController:
@@ -59,6 +83,7 @@ class AdmissionController:
         self.cluster = cluster
         self.n_queue = max(1, n_queue)
         self._warm: set[str] = set()
+        self._needed: dict = {}       # Profile -> frozenset of names
         self._lock = threading.Lock()
 
     # -- shape derivation --------------------------------------------------
@@ -103,12 +128,21 @@ class AdmissionController:
             self._digest = pool_mod.key_digest(self.cluster.coll_tbl.table)
         return self._digest
 
-    @staticmethod
-    def needed(profile: cc.Profile) -> set[str]:
+    def needed(self, profile: cc.Profile) -> frozenset:
         """Names of the programs this shape would dispatch on the current
-        backend (gate-filtered: skipped programs never go cold)."""
-        return {s.name for s in cc.build_registry(profile)
-                if s.dispatched()}
+        backend (gate-filtered: skipped programs never go cold). Memoized
+        per profile: under load the registry enumeration would otherwise
+        re-run on EVERY submit — the triage hot path must stay O(set
+        lookup) once a shape has been seen."""
+        with self._lock:
+            cached = self._needed.get(profile)
+        if cached is not None:
+            return cached
+        names = frozenset(s.name for s in cc.build_registry(profile)
+                          if s.dispatched())
+        with self._lock:
+            self._needed[profile] = names
+        return names
 
     # -- warm set ----------------------------------------------------------
 
@@ -121,7 +155,7 @@ class AdmissionController:
         with self._lock:
             self._warm |= names
 
-    def triage(self, sq) -> Admission:
+    def triage(self, sq, tenant: str = "default") -> Admission:
         """Lane order: cold programs -> "compile"; warm programs but a
         pool balance short of the survey's noise need -> "refill" (the
         scheduler deposits slabs cooperatively, then re-triages); else
@@ -133,16 +167,18 @@ class AdmissionController:
         if profile is None:
             lane = "fast"
         else:
+            names = self.needed(profile)
             with self._lock:
-                missing = tuple(sorted(self.needed(profile) - self._warm))
+                missing = tuple(sorted(names - self._warm))
             lane = "compile" if missing else "fast"
         pool = getattr(self.cluster, "pool", None)
         if (lane == "fast" and need > 0 and pool is not None
                 and pool.dro_balance(self._pool_digest()) < need):
             lane = "refill"
         return Admission(survey_id=sq.survey_id, lane=lane,
-                         profile=profile, missing=missing, dro_need=need)
+                         profile=profile, missing=missing, dro_need=need,
+                         tenant=tenant)
 
 
 __all__ = ["Admission", "AdmissionController", "AdmissionError",
-           "QueueFull"]
+           "QueueFull", "QuotaExceeded", "Overloaded"]

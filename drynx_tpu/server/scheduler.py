@@ -1,34 +1,52 @@
 """Standing survey scheduler: bounded lanes, a cooperative compile lane,
-cross-survey batched verification, and a two-stage encode/verify pipeline.
+cross-survey batched verification, a two-stage encode/verify pipeline,
+per-tenant fair queueing, and admission-controlled shedding.
 
 Threading rules (inherited from the r05 segfault class — COMPILECACHE.md):
 
-  * ALL jit tracing stays on the thread that calls ``drain()`` (normally
-    the main thread). The compile lane is "background" only in the
-    scheduling sense: promotion runs the PR-3 precompile driver
+  * ALL jit tracing stays on the thread that calls ``drain()``/``serve()``
+    (normally the main thread). The compile lane is "background" only in
+    the scheduling sense: promotion runs the PR-3 precompile driver
     cooperatively BETWEEN surveys on the drain thread, under the
     cluster's proof-device lock with trace_guard applied — never on a
     worker thread.
-  * The single verify worker thread only ever RE-EXECUTES warm programs:
-    a fast-lane verdict certifies the full program set for the shape
+  * Verify worker threads only ever RE-EXECUTE warm programs: a
+    fast-lane verdict certifies the full program set for the shape
     (including the CrossSurveyVerify concat buckets — admission folds
     ``n_queue`` into the profile), and on CPU the heavy verify families
     take the host-oracle detour (pure host compute, no tracing at all).
-    tests/test_server.py hooks ``batching.TRACE_HOOK`` to prove the
-    pipeline never traces off the drain thread. The worker's thread
-    target is a bound method by design — the static thread-trace lint
-    (analysis/rules.py) flags jit first-touch, which this thread cannot
-    perform; see SERVER.md.
+    The contract is per-PROCESS, not per-thread — the dispatch caches
+    the compile lane warms are process-wide — so a pool of N workers
+    (``workers=N`` / DRYNX_VERIFY_WORKERS) is exactly as trace-free as
+    the single worker was: tests/test_server.py hooks
+    ``batching.TRACE_HOOK`` to prove the pipeline never traces off the
+    drain thread. Worker thread targets are bound methods by design —
+    the static thread-trace lint (analysis/rules.py) flags jit
+    first-touch, which these threads cannot perform; see SERVER.md.
 
 Pipelining interleaves *dispatch*: survey N+1's DP encode (drain thread)
-overlaps survey N's VN verification (worker thread). PhaseTimers absolute
-spans (``Pipeline.encode.<sid>`` / ``Pipeline.verify.<sid>``) record the
-overlap; ``pipeline_overlap`` integrates it.
+overlaps survey N's VN verification (worker threads). PhaseTimers
+absolute spans (``Pipeline.encode.<sid>`` / ``Pipeline.verify.<sid>``)
+record the overlap; ``pipeline_overlap`` integrates it.
+
+Fairness (PR 12): the fast lane is one deque PER TENANT, served by
+deficit round-robin — each visit credits a tenant ``max_batch × weight``
+quantum and pops at most that many shape-equal entries, so a hot tenant
+that keeps its queue full cannot starve the others (its deficit never
+accumulates faster than its weight) while a single-tenant server behaves
+exactly as the historical FIFO did. On top of the bounded total depth
+(``QueueFull``), each tenant holds at most ``tenant_quota`` queued
+surveys (typed ``QuotaExceeded``), and past ``shed_fraction × max_depth``
+total depth submit() sheds with a typed ``Overloaded`` carrying a
+retry-after hint computed from the observed completion rate — reject
+early and cheap instead of letting the queue ride into collapse.
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
+import math
+import os
 import queue
 import secrets
 import threading
@@ -46,44 +64,71 @@ class _Entry:
     sq: object
     seed: int
     admission: adm.Admission
+    tenant: str = "default"
+    # survey resume (ROADMAP item 6, minimal slice): a dispatch failure
+    # re-enters the queue at most RESUME_MAX_RETRIES times, with the
+    # post-probe live responder set carried into the retry
+    retries: int = 0
+    responders: tuple | None = None
 
 
-# The program set the verify WORKER dispatches as real jits on CPU: the
-# mod-p/mod-n scalar family used by payload deserialization (to_mont_p in
-# _g1/_g2/_gt _from_bytes), the RLC weights (int_to_scalar, fn_*), and the
-# wire encoders. The g1/pairing families host-detour on CPU and everything
-# else dispatches from the drain thread — so executing exactly this set
-# during a lower-mode compile pass keeps the worker trace-free.
-_WORKER_OPS = frozenset({
-    "fn_add", "fn_sub", "fn_neg", "fn_mul_plain", "fn_mont_mul",
-    "int_to_scalar", "to_mont_p", "from_mont_p",
-})
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if v in (None, "") else int(v)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
 
 
 class SurveyServer:
     """A standing scheduler over one LocalCluster.
 
     ``submit()`` triages surveys into the fast or compile lane (bounded
-    total depth — ``QueueFull`` past ``max_depth``); ``drain()`` processes
-    both lanes to empty on the calling thread and returns per-survey
-    results. Fast-lane surveys with equal shape are grouped (up to
-    ``max_batch``) and their range payloads held at the VNs for ONE
-    cross-survey joint verification; a shape miss costs one cooperative
-    precompile pass, after which the survey is re-admitted.
+    total depth — ``QueueFull`` past ``max_depth``, ``Overloaded`` past
+    the shed threshold, ``QuotaExceeded`` past one tenant's quota);
+    ``drain()`` processes all lanes to empty on the calling thread and
+    returns per-survey results, ``serve(stop)`` runs the same loop until
+    signalled (the load-harness entry point). Fast-lane surveys with
+    equal shape are grouped (up to ``max_batch``) and their range
+    payloads held at the VNs for ONE cross-survey joint verification; a
+    shape miss costs one cooperative precompile pass, after which the
+    survey is re-admitted.
 
     ``pipeline=False`` degrades to strictly serial execute+finalize on
     the drain thread (the reference configuration for transcript
-    comparison); batching still applies.
+    comparison); batching still applies. ``workers=N`` widens the verify
+    pool (default ``policy.VERIFY_WORKERS``, env DRYNX_VERIFY_WORKERS);
+    group composition is still decided on the drain thread, so
+    transcripts are byte-identical at any width.
     """
 
     def __init__(self, cluster, max_batch: int = 4, max_depth: int = 16,
-                 pipeline: bool = True, compile_mode: str | None = None):
+                 pipeline: bool = True, compile_mode: str | None = None,
+                 workers: int | None = None,
+                 tenant_quota: int | None = None,
+                 tenant_weights: dict | None = None,
+                 shed_fraction: float | None = None):
         from ..crypto import pallas_ops as po
 
         self.cluster = cluster
         self.max_batch = max(1, max_batch)
         self.max_depth = max(1, max_depth)
         self.pipeline = pipeline
+        self.workers = max(1, int(workers) if workers is not None
+                           else _env_int("DRYNX_VERIFY_WORKERS",
+                                         rp.VERIFY_WORKERS))
+        self.tenant_quota = max(1, int(tenant_quota)
+                                if tenant_quota is not None
+                                else _env_int("DRYNX_TENANT_QUOTA",
+                                              rp.TENANT_QUOTA))
+        frac = (float(shed_fraction) if shed_fraction is not None
+                else _env_float("DRYNX_SHED_FRACTION", rp.SHED_FRACTION))
+        # fraction >= 1 disables shedding: only the hard depth bound
+        # applies (the historical behavior)
+        self._shed_depth = (self.max_depth if frac >= 1.0
+                            else max(1, math.ceil(frac * self.max_depth)))
         self.admission = adm.AdmissionController(cluster,
                                                  n_queue=self.max_batch)
         # "execute" is the only mode that warms dispatch caches, but on
@@ -95,37 +140,69 @@ class SurveyServer:
         self.compile_mode = compile_mode or (
             "execute" if po.available() else "lower")
         self.timers = PhaseTimers()
-        self._fast: collections.deque = collections.deque()
+        # fast lane: one FIFO per tenant under deficit round-robin
+        self._fast: dict[str, collections.deque] = {}
+        self._rr_order: list[str] = []
+        self._rr_idx = 0
+        self._deficit: dict[str, float] = {}
+        self._weights: dict[str, float] = dict(tenant_weights or {})
         self._compile: collections.deque = collections.deque()
         # refill lane: surveys whose programs are warm but whose DRO
         # noise need exceeds the pool balance (admission lane "refill").
-        # The drain thread deposits ONE slab per iteration — cooperative,
-        # fast-lane-preemptible, same pattern as the compile lane — so
-        # refill overlaps the verify worker (the pipeline gaps).
+        # The drain thread deposits slabs cooperatively (demand-aware:
+        # enough to cover the waiting need plus the observed consumption
+        # rate over REFILL_HORIZON_S, capped per step) — fast-lane-
+        # preemptible, same pattern as the compile lane — so refill
+        # overlaps the verify workers (the pipeline gaps).
         self._refill: collections.deque = collections.deque()
         self.refill_slabs = 0
         self._results: dict[str, object] = {}
         self._errors: dict[str, Exception] = {}
         self._admissions: dict[str, adm.Admission] = {}
         self._lock = threading.Lock()
+        self._results_lock = threading.Lock()
+        # completion clock: drives the Overloaded retry-after hint and
+        # the refill lane's demand forecast
+        self._done_t: collections.deque = collections.deque(
+            maxlen=rp.RATE_WINDOW_EVENTS)
+        self._dro_done: collections.deque = collections.deque(
+            maxlen=rp.RATE_WINDOW_EVENTS)
+        # optional completion callback: on_done(survey_id, ok) fires
+        # exactly once per admitted survey, from whichever thread
+        # recorded the outcome (the load generator's latency clock)
+        self.on_done = None
         self._verify_q: queue.Queue = queue.Queue()
-        self._worker: threading.Thread | None = None
+        self._workers: list[threading.Thread] = []
 
     # -- intake ------------------------------------------------------------
 
-    def submit(self, sq, seed: int = 0) -> adm.Admission:
-        """Triage + enqueue. Raises QueueFull at max_depth (typed
-        rejection — the caller backs off; nothing is dropped silently)."""
+    def submit(self, sq, seed: int = 0,
+               tenant: str = "default") -> adm.Admission:
+        """Triage + enqueue under three typed admission gates, checked in
+        order: QueueFull at max_depth (the hard bound), QuotaExceeded at
+        this tenant's queued-survey quota, Overloaded past the shed
+        threshold (with a retry_after_s hint). Nothing admitted is ever
+        dropped silently."""
         with self._lock:
-            depth = (len(self._fast) + len(self._compile)
-                     + len(self._refill))
+            depth = self._depth_locked()
             if depth >= self.max_depth:
                 raise adm.QueueFull(
                     f"queue at max_depth={self.max_depth}; survey "
                     f"{sq.survey_id!r} rejected")
-            a = self.admission.triage(sq)
+            if self._tenant_depth_locked(tenant) >= self.tenant_quota:
+                raise adm.QuotaExceeded(
+                    f"tenant {tenant!r} at quota={self.tenant_quota}; "
+                    f"survey {sq.survey_id!r} rejected",
+                    tenant=tenant, quota=self.tenant_quota)
+            if depth >= self._shed_depth:
+                raise adm.Overloaded(
+                    f"queue sheds past depth {self._shed_depth} "
+                    f"({depth} queued); survey {sq.survey_id!r} rejected",
+                    retry_after_s=self._retry_after(depth))
+            a = self.admission.triage(sq, tenant=tenant)
             self._admissions[sq.survey_id] = a
-            self._route_locked(_Entry(sq=sq, seed=seed, admission=a))
+            self._route_locked(_Entry(sq=sq, seed=seed, admission=a,
+                                      tenant=tenant))
         return a
 
     def prewarm(self, sq) -> adm.Admission:
@@ -139,13 +216,70 @@ class SurveyServer:
     def admission_of(self, survey_id: str) -> adm.Admission | None:
         return self._admissions.get(survey_id)
 
+    def _depth_locked(self) -> int:
+        return (sum(len(q) for q in self._fast.values())
+                + len(self._compile) + len(self._refill))
+
+    def _tenant_depth_locked(self, tenant: str) -> int:
+        return (len(self._fast.get(tenant, ()))
+                + sum(1 for e in self._compile if e.tenant == tenant)
+                + sum(1 for e in self._refill if e.tenant == tenant))
+
     def _route_locked(self, entry: _Entry) -> None:
         """Append an entry to the deque its admission lane names
         (caller holds self._lock)."""
-        lane = {"compile": self._compile,
-                "refill": self._refill}.get(entry.admission.lane,
-                                            self._fast)
-        lane.append(entry)
+        if entry.admission.lane == "compile":
+            self._compile.append(entry)
+        elif entry.admission.lane == "refill":
+            self._refill.append(entry)
+        else:
+            self._requeue_locked(entry)
+
+    def _requeue_locked(self, entry: _Entry) -> None:
+        """Fast-lane append for entry.tenant, registering the tenant in
+        the round-robin order on first sight. Resume re-entries come
+        through here directly — an already-admitted survey bypasses the
+        admission gates (it never logically left the queue)."""
+        t = entry.tenant
+        q = self._fast.get(t)
+        if q is None:
+            q = self._fast[t] = collections.deque()
+            self._rr_order.append(t)
+            self._deficit[t] = 0.0
+        q.append(entry)
+
+    # -- overload bookkeeping ----------------------------------------------
+
+    def _observed_rate(self) -> float:
+        """Completions per second over the recent done-event window
+        (0.0 until two completions have landed)."""
+        with self._results_lock:
+            ts = list(self._done_t)
+        if len(ts) < 2 or ts[-1] <= ts[0]:
+            return 0.0
+        return (len(ts) - 1) / (ts[-1] - ts[0])
+
+    def _retry_after(self, depth: int) -> float:
+        """The Overloaded hint: how long until the backlog above the shed
+        threshold clears at the observed completion rate, clamped to
+        [SHED_RETRY_MIN_S, SHED_RETRY_MAX_S] (a cold server with no rate
+        yet hints the max)."""
+        rate = self._observed_rate()
+        if rate <= 0.0:
+            return rp.SHED_RETRY_MAX_S
+        backlog = depth - self._shed_depth + 1
+        return min(rp.SHED_RETRY_MAX_S,
+                   max(rp.SHED_RETRY_MIN_S, backlog / rate))
+
+    def _dro_rate(self) -> float:
+        """Observed DRO pool consumption (elements/s) — the refill
+        lane's demand forecast input."""
+        with self._results_lock:
+            evs = list(self._dro_done)
+        if len(evs) < 2 or evs[-1][0] <= evs[0][0]:
+            return 0.0
+        return (sum(n for _, n in evs[1:])
+                / (evs[-1][0] - evs[0][0]))
 
     # -- compile lane (cooperative, drain thread only) ---------------------
 
@@ -158,11 +292,12 @@ class SurveyServer:
             if self.compile_mode == "lower":
                 # the CPU lane: lowering alone doesn't warm dispatch
                 # caches — execute just the cheap scalar family the
-                # verify worker would otherwise first-trace off this
-                # thread (see _WORKER_OPS)
+                # verify workers would otherwise first-trace off this
+                # thread (cc.WORKER_OPS; the registry owns the set so
+                # warm coverage and the execute filter stay in lockstep)
                 cc.precompile(profile, mode="execute",
                               only=lambda s: (s.family == "device"
-                                              and s.op in _WORKER_OPS),
+                                              and s.op in cc.WORKER_OPS),
                               log=lambda m: log.lvl2(f"server warm: {m}"))
         self.timers.span(f"Compile.{survey_id}", t0, time.perf_counter())
         self.admission.note_warmed(profile)
@@ -174,7 +309,8 @@ class SurveyServer:
         log.lvl2(f"server: compiling shape for {sid} "
                  f"({len(entry.admission.missing)} cold programs)")
         self._compile_profile(entry.admission.profile, sid)
-        entry.admission = self.admission.triage(entry.sq)
+        entry.admission = self.admission.triage(entry.sq,
+                                                tenant=entry.tenant)
         with self._lock:
             self._admissions[sid] = entry.admission
             # now warm — but a short pool still routes it via refill
@@ -183,81 +319,132 @@ class SurveyServer:
     # -- refill lane (cooperative, drain thread only) ----------------------
 
     def _refill_step(self, entry: _Entry) -> None:
-        """Deposit ONE pool slab toward this entry's DRO need, then
-        re-triage. Runs on the drain thread under the proof-device lock
-        (the slab precompute is a real device dispatch — same threading
-        contract as the compile lane), so it fills the encode/verify
-        pipeline gaps: while the verify worker grinds survey N, the
-        drain thread banks randomness for survey N+1."""
+        """Deposit pool slabs toward this entry's DRO need, then
+        re-triage. Demand-aware: the target is the waiting survey's need
+        plus the observed consumption rate integrated over
+        REFILL_HORIZON_S (so a busy diffp tenant banks ahead of its next
+        survey), capped at REFILL_MAX_SLABS_STEP slabs per cooperative
+        step so the fast and compile lanes still preempt promptly. Runs
+        on the drain thread under the proof-device lock (the slab
+        precompute is a real device dispatch — same threading contract
+        as the compile lane), so it fills the encode/verify pipeline
+        gaps: while the verify workers grind survey N, the drain thread
+        banks randomness for survey N+1."""
         from .. import pool as pool_mod
 
         sid = entry.sq.survey_id
         pool = self.cluster.pool
+        digest = self.admission._pool_digest()
+        target = (entry.admission.dro_need
+                  + int(self._dro_rate() * rp.REFILL_HORIZON_S))
         t0 = time.perf_counter()
-        with self.cluster._proof_device_lock:
-            cc.trace_guard()
-            import jax
+        deposited = 0
+        while deposited < rp.REFILL_MAX_SLABS_STEP:
+            with self.cluster._proof_device_lock:
+                cc.trace_guard()
+                import jax
 
-            k = jax.random.PRNGKey(secrets.randbits(63))
-            pool_mod.replenish.refill_slab(pool, k,
-                                           self.cluster.coll_tbl.table)
-        self.refill_slabs += 1
+                k = jax.random.PRNGKey(secrets.randbits(63))
+                pool_mod.replenish.refill_slab(pool, k,
+                                               self.cluster.coll_tbl.table)
+            deposited += 1
+            self.refill_slabs += 1
+            if pool.dro_balance(digest) >= target:
+                break
         self.timers.span(f"Refill.{sid}", t0, time.perf_counter())
-        entry.admission = self.admission.triage(entry.sq)
+        entry.admission = self.admission.triage(entry.sq,
+                                                tenant=entry.tenant)
         with self._lock:
             self._admissions[sid] = entry.admission
             self._route_locked(entry)
 
     # -- drain loop --------------------------------------------------------
 
+    def _drain_step(self) -> bool:
+        """One scheduling decision on the calling thread; False when all
+        lanes are empty. Fast work first, then compile (it unblocks
+        encodes that feed the verify pipeline), then refill — the refill
+        lane is pure gap work: slab deposits overlap whatever the verify
+        workers are grinding, and nothing downstream waits on them until
+        their survey is next."""
+        group = None
+        entry = None
+        rentry = None
+        with self._lock:
+            if any(len(q) for q in self._fast.values()):
+                group = self._pop_group_locked()
+            elif self._compile:
+                entry = self._compile.popleft()
+            elif self._refill:
+                rentry = self._refill.popleft()
+            else:
+                return False
+        if group is not None:
+            self._run_group(group)
+        elif rentry is not None:
+            self._refill_step(rentry)
+        elif entry is not None:
+            self._promote(entry)
+        return True
+
     def drain(self) -> dict:
-        """Process both lanes to empty ON THE CALLING THREAD (the tracing
-        thread), then wait for the verify worker to finish. Returns
-        {survey_id: SurveyResult | Exception}. Fast-lane work always
-        preempts the compile lane, so a cold shape never stalls warm
-        surveys behind its compile pass."""
-        while True:
-            group = None
-            entry = None
-            rentry = None
-            with self._lock:
-                # fast work first, then compile (it unblocks encodes
-                # that feed the verify pipeline), then refill — the
-                # refill lane is pure gap work: slab deposits overlap
-                # whatever the verify worker is grinding, and nothing
-                # downstream waits on them until their survey is next
-                if self._fast:
-                    group = self._pop_group_locked()
-                elif self._compile:
-                    entry = self._compile.popleft()
-                elif self._refill:
-                    rentry = self._refill.popleft()
-                else:
-                    break
-            if group is not None:
-                self._run_group(group)
-            elif rentry is not None:
-                self._refill_step(rentry)
-            elif entry is not None:
-                self._promote(entry)
+        """Process all lanes to empty ON THE CALLING THREAD (the tracing
+        thread), then wait for the verify workers to finish. Returns
+        {survey_id: SurveyResult | Exception}."""
+        while self._drain_step():
+            pass
         self._verify_q.join()
         return self.results()
 
+    def serve(self, stop: threading.Event,
+              idle_s: float | None = None) -> dict:
+        """Drain continuously until ``stop`` is set, sleeping ``idle_s``
+        when all lanes are empty — the standing-load entry point
+        (loadgen submits from other threads while this loop runs on the
+        tracing thread). On stop, finishes whatever is queued and joins
+        the verify pool, so every admitted survey still completes."""
+        idle = rp.POLL_INTERVAL_S if idle_s is None else idle_s
+        while not stop.is_set():
+            if not self._drain_step():
+                time.sleep(idle)
+        return self.drain()
+
     def results(self) -> dict:
-        out: dict = dict(self._results)
-        out.update(self._errors)
+        with self._results_lock:
+            out: dict = dict(self._results)
+            out.update(self._errors)
         return out
 
     def _pop_group_locked(self) -> list:
-        """Maximal run of shape-equal fast-lane entries, up to max_batch.
-        Proofs-off surveys (profile None) never group."""
-        group = [self._fast.popleft()]
-        key = group[0].admission.profile
-        while (key is not None and self._fast
-               and len(group) < self.max_batch
-               and self._fast[0].admission.profile == key):
-            group.append(self._fast.popleft())
-        return group
+        """Deficit round-robin across tenants, then a maximal run of
+        shape-equal entries from the chosen tenant's FIFO (up to the
+        tenant's accrued quantum, never more than max_batch; proofs-off
+        surveys — profile None — never group). Each visit to a backlogged
+        tenant credits ``max_batch × weight``, so relative service rates
+        follow the weights while a lone tenant gets whole batches exactly
+        like the historical single-FIFO scheduler. A tenant's unused
+        deficit is forfeited when its queue empties (classic DRR — idle
+        tenants cannot bank credit)."""
+        while True:
+            t = self._rr_order[self._rr_idx % len(self._rr_order)]
+            self._rr_idx = (self._rr_idx + 1) % len(self._rr_order)
+            q = self._fast.get(t)
+            if not q:
+                self._deficit[t] = 0.0
+                continue
+            self._deficit[t] += self.max_batch * self._weights.get(t, 1.0)
+            take = min(int(self._deficit[t]), self.max_batch)
+            if take < 1:
+                continue
+            group = [q.popleft()]
+            key = group[0].admission.profile
+            while (key is not None and q and len(group) < take
+                   and q[0].admission.profile == key):
+                group.append(q.popleft())
+            self._deficit[t] -= len(group)
+            if not q:
+                self._deficit[t] = 0.0
+            return group
 
     # -- encode stage (drain thread) ---------------------------------------
 
@@ -269,16 +456,32 @@ class SurveyServer:
             t0 = time.perf_counter()
             try:
                 p = self.cluster.execute_survey(e.sq, e.seed,
-                                                hold_range=hold)
+                                                hold_range=hold,
+                                                tenant=e.tenant,
+                                                responders=e.responders)
             except Exception as exc:
-                # quorum failure / mid-survey fault: this survey degrades
-                # alone — its batch partners flush without it (a held
-                # survey is only included in the cross flush once ALL its
-                # expected payloads arrived; see flush_ranges_cross)
-                log.warn(f"server: survey {sid} failed in encode: {exc}")
-                self._errors[sid] = exc
                 self.timers.span(f"Pipeline.encode.{sid}",
                                  t0, time.perf_counter())
+                if e.retries < rp.RESUME_MAX_RETRIES:
+                    # survey resume (minimal slice): re-probe liveness,
+                    # carry the responder set, re-enter the queue ONCE.
+                    # The retry bypasses admission gates — the survey
+                    # was already admitted and never logically left.
+                    e.retries += 1
+                    e.responders = self._reprobe()
+                    log.warn(f"server: survey {sid} failed in dispatch "
+                             f"({exc}); re-queued with "
+                             f"responders={e.responders}")
+                    with self._lock:
+                        self._requeue_locked(e)
+                    continue
+                # quorum failure / mid-survey fault after its retry:
+                # this survey degrades alone — its batch partners flush
+                # without it (a held survey is only included in the
+                # cross flush once ALL its expected payloads arrived;
+                # see flush_ranges_cross)
+                log.warn(f"server: survey {sid} failed in encode: {exc}")
+                self._record_error(sid, exc)
                 continue
             self.timers.span(f"Pipeline.encode.{sid}",
                              t0, time.perf_counter())
@@ -286,19 +489,37 @@ class SurveyServer:
         if not pendings:
             return
         if self.pipeline:
-            self._ensure_worker()
+            self._ensure_workers()
             self._verify_q.put(pendings)
         else:
             self._verify_group(pendings)
 
-    # -- verify stage (single worker thread; re-execution only) ------------
+    def _reprobe(self) -> tuple | None:
+        """The resume re-triage: the cluster's concurrent liveness probe
+        (None — no restriction — when the cluster has none or it fails)."""
+        probe = getattr(self.cluster, "probe_liveness", None)
+        if probe is None:
+            return None
+        try:
+            alive = probe()
+        except Exception as exc:
+            log.warn(f"server: liveness re-probe failed: {exc}")
+            return None
+        return tuple(sorted(n for n, ok in alive.items() if ok))
 
-    def _ensure_worker(self) -> None:
-        if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(target=self._verify_loop,
-                                            name="server-verify",
-                                            daemon=True)
-            self._worker.start()
+    # -- verify stage (worker pool; re-execution only) ---------------------
+
+    def _ensure_workers(self) -> None:
+        # called from the drain thread only; workers share one queue, so
+        # join() still synchronizes whatever the pool width
+        self._workers = [t for t in self._workers if t.is_alive()]
+        while len(self._workers) < self.workers:
+            i = len(self._workers)
+            name = "server-verify" if i == 0 else f"server-verify-{i}"
+            t = threading.Thread(target=self._verify_loop, name=name,
+                                 daemon=True)
+            t.start()
+            self._workers.append(t)
 
     def _verify_loop(self) -> None:
         while True:
@@ -330,13 +551,40 @@ class SurveyServer:
             sid = p.sq.survey_id
             t0 = time.perf_counter()
             try:
-                self._results[sid] = self.cluster.finalize_survey(p)
+                self._record_result(sid, self.cluster.finalize_survey(p))
             except Exception as exc:
                 log.warn(f"server: survey {sid} failed in verify: {exc}")
-                self._errors[sid] = exc
+                self._record_error(sid, exc)
             finally:
                 self.timers.span(f"Pipeline.verify.{sid}",
                                  t0, time.perf_counter())
+
+    # -- outcome recording (any thread) ------------------------------------
+
+    def _record_result(self, sid: str, res) -> None:
+        with self._results_lock:
+            self._results[sid] = res
+        self._note_done(sid, ok=True)
+
+    def _record_error(self, sid: str, exc: Exception) -> None:
+        with self._results_lock:
+            self._errors[sid] = exc
+        self._note_done(sid, ok=False)
+
+    def _note_done(self, sid: str, ok: bool) -> None:
+        now = time.monotonic()
+        a = self._admissions.get(sid)
+        with self._results_lock:
+            self._done_t.append(now)
+            if a is not None and a.dro_need:
+                self._dro_done.append((now, a.dro_need))
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb(sid, ok)
+            except Exception as exc:
+                log.warn(f"server: on_done callback failed for "
+                         f"{sid}: {exc}")
 
 
 def refill_overlap(timers: PhaseTimers) -> float:

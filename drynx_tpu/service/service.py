@@ -546,14 +546,40 @@ class LocalCluster:
     def run_survey(self, sq: SurveyQuery, seed: int = 0):
         return self.finalize_survey(self.execute_survey(sq, seed))
 
+    def probe_liveness(self) -> dict:
+        """Concurrent DP liveness probe — the survey-resume re-triage hook
+        (ROADMAP item 6): one ping per DP over the fan_out pool through
+        transport.local_call, so an active FaultPlan's connect/node hooks
+        decide reachability exactly as a TCP probe would. Without a plan
+        every in-process DP is trivially alive."""
+        from . import node as nd
+        from . import transport as tr
+
+        # DP names are public routing metadata (same declassification as
+        # the execute_survey probe loop)
+        names = [d.name for d in self.dp_idents]  # drynx: declassify[secret]
+        if faults.fault_plan() is None:
+            return {n: True for n in names}
+        outs = nd.fan_out(
+            names, lambda n: None,
+            call=lambda n, m: tr.local_call(n, "ping", lambda: True))
+        return {n: err is None for n, (_, err) in zip(names, outs)}
+
     def execute_survey(self, sq: SurveyQuery, seed: int = 0,
-                       hold_range: bool = False):
+                       hold_range: bool = False, tenant: str = "default",
+                       responders: Optional[list] = None):
         """Phases through decrypt+decode; returns a PendingSurvey whose
         proof verification has not been finalized. run_survey composes this
         with finalize_survey; the standing scheduler (drynx_tpu.server)
         splits them so survey N+1's encode overlaps survey N's verify, and
         passes hold_range=True so queued surveys' range payloads buffer at
-        the VNs for ONE cross-survey joint flush."""
+        the VNs for ONE cross-survey joint flush.
+
+        ``responders`` restricts the DP candidate set to the named nodes
+        (survey resume carries the live set from a probe_liveness pass);
+        DPs outside it are recorded absent and the quorum check applies
+        to the restriction. ``tenant`` tags the PendingSurvey/SurveyResult
+        for the server's fair-queueing bookkeeping."""
         survey = Survey(sq)
         self.surveys[sq.survey_id] = survey
         q = sq.query
@@ -570,22 +596,29 @@ class LocalCluster:
         # responders iff they meet min_dp_quorum, and the VN
         # expected-proof counters are sized to the responder set.
         plan = faults.fault_plan()
+        allowed = None if responders is None else {str(n)
+                                                  for n in responders}
         dp_idents: list = []
         absent: list[str] = []
-        if plan is not None:
-            from . import transport as tr
+        for d in self.dp_idents:
+            # DP names are public routing metadata even though the
+            # identity objects also carry the node's secret scalar
+            name = d.name  # drynx: declassify[secret]
+            if allowed is not None and name not in allowed:
+                # resume carried a responder set that excludes this DP:
+                # it is absent by restriction, no probe needed
+                absent.append(name)
+                continue
+            if plan is not None:
+                from . import transport as tr
 
-            for d in self.dp_idents:
-                # DP names are public routing metadata even though the
-                # identity objects also carry the node's secret scalar
-                name = d.name  # drynx: declassify[secret]
                 try:
                     tr.local_call(name, "survey_query", lambda: None)
                     dp_idents.append(d)
                 except tr.TransportError:
                     absent.append(name)
-        else:
-            dp_idents = list(self.dp_idents)
+            else:
+                dp_idents.append(d)
         responders = [d.name for d in dp_idents]
         need = (sq.min_dp_quorum if sq.min_dp_quorum > 0
                 else len(self.dp_idents))
@@ -866,7 +899,7 @@ class LocalCluster:
         return PendingSurvey(survey=survey, sq=sq, result=result,
                              decrypted=dec, responders=responders,
                              absent=sorted(absent), proofs_on=proofs_on,
-                             hold_range=hold_range)
+                             hold_range=hold_range, tenant=tenant)
 
     def finalize_survey(self, pending: "PendingSurvey"):
         """Join the survey's proof threads, end VN verification, and
@@ -904,7 +937,8 @@ class LocalCluster:
                             decrypted=pending.decrypted, block=block,
                             timers=tm, survey_id=sid,
                             responders=pending.responders,
-                            absent=pending.absent)
+                            absent=pending.absent,
+                            tenant=pending.tenant)
 
     # ------------------------------------------------------------------
     def _async_proof(self, survey: Survey, ptype: str, ident: NodeIdentity,
@@ -1017,6 +1051,7 @@ class PendingSurvey:
     absent: list
     proofs_on: bool
     hold_range: bool = False
+    tenant: str = "default"    # fair-queueing lane key (server DRR/quota)
 
 
 @dataclasses.dataclass
@@ -1029,6 +1064,7 @@ class SurveyResult:
     # quorum bookkeeping: which DPs actually contributed (ROBUSTNESS.md)
     responders: list = dataclasses.field(default_factory=list)
     absent: list = dataclasses.field(default_factory=list)
+    tenant: str = "default"
 
 
 def _pickle(obj) -> bytes:

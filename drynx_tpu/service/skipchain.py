@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import threading
 import time
 from typing import Callable, Optional
 
@@ -74,19 +75,23 @@ class SkipChain:
         self.verifiers = list(verifiers or [])
         n = db.get("chain/length")
         self._length = int(n.decode()) if n else 0
+        # append is a read-modify-write on _length: with a verify-worker
+        # POOL (server/scheduler.py) two surveys' end_verification commits
+        # can race here, so the chain extension is serialized
+        self._append_lock = threading.Lock()
 
     # -- reference API surface: CreateProofSkipchain / AppendProofSkipchain
     def create_genesis(self, data: DataBlock) -> Block:
-        if self._length != 0:
-            raise ValueError("chain already has a genesis block")
-        return self._append(data)
+        with self._append_lock:
+            if self._length != 0:
+                raise ValueError("chain already has a genesis block")
+            return self._append_locked(data)
 
     def append(self, data: DataBlock) -> Block:
-        if self._length == 0:
-            return self.create_genesis(data)
-        return self._append(data)
+        with self._append_lock:
+            return self._append_locked(data)
 
-    def _append(self, data: DataBlock) -> Block:
+    def _append_locked(self, data: DataBlock) -> Block:
         prev = self.latest()
         blk = Block(index=self._length,
                     prev_hash=prev.hash() if prev else "", data=data)

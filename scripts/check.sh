@@ -53,7 +53,11 @@ python scripts/bench_tree_rosters.py --smoke > /dev/null
 
 echo "== server tier (standing scheduler quick tests + 3-survey demo) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not slow' \
-    tests/test_server.py
+    tests/test_server.py tests/test_loadgen.py
 JAX_PLATFORMS=cpu python scripts/serve_surveys.py > /dev/null
+
+echo "== load smoke (bursty open loop + adversarial mix over one supervised"
+echo "== child: zero lost, typed sheds with hints, bounded fairness) =="
+python scripts/bench_load.py --smoke > /dev/null
 
 echo "check.sh: all green"

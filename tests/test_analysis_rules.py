@@ -368,6 +368,41 @@ def test_hardcoded_timeout_allows_policy_backed_tree_knobs():
     assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
 
 
+def test_hardcoded_timeout_covers_admission_knobs():
+    src = """
+        import os
+
+        def admit(sq, tenant_quota=8):
+            srv = serve(shed_fraction=0.75)
+            q = int(os.environ.get("DRYNX_TENANT_QUOTA", 16))
+            hint(retry_after_s=30.0)
+            pool = spawn(verify_workers=4)
+    """
+    found = run(src, relpath=SERVICE, rule="hardcoded-timeout")
+    assert len(found) == 5
+    texts = " ".join(f.message for f in found)
+    assert "tenant_quota=8" in texts and "shed_fraction=0.75" in texts
+    assert "retry_after_s=30.0" in texts and "verify_workers=4" in texts
+
+
+def test_hardcoded_timeout_allows_policy_backed_admission_knobs():
+    # the scheduler idiom: env knobs fall back to None/policy constants,
+    # never to numeric literals; "finished" must NOT match the shed family
+    src = """
+        import os
+        from drynx_tpu.resilience import policy as rp
+
+        def admit(sq, tenant_quota=None, shed_fraction=None):
+            raw = os.environ.get("DRYNX_VERIFY_WORKERS", "")
+            w = int(raw or 0) or rp.VERIFY_WORKERS
+            srv = serve(tenant_quota=rp.TENANT_QUOTA,
+                        shed_fraction=rp.SHED_FRACTION)
+            hint(retry_after_s=rp.SHED_RETRY_MAX_S)
+            done(finished=3)
+    """
+    assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
+
+
 # -- suppression + baseline mechanics ---------------------------------------
 
 def test_noqa_suppresses_named_rule_only():

@@ -20,7 +20,8 @@ import numpy as np
 import pytest
 
 from drynx_tpu import compilecache as cc
-from drynx_tpu.server import (AdmissionController, QueueFull, SurveyServer,
+from drynx_tpu.server import (AdmissionController, Overloaded, QueueFull,
+                              QuotaExceeded, SurveyServer,
                               pipeline_overlap, survey_transcript,
                               transcript_digest)
 from drynx_tpu.utils.timers import PhaseTimers
@@ -58,6 +59,19 @@ def test_registry_n_queue_one_is_identity():
     assert {s.name for s in one} == {s.name for s in cc.build_registry(base)}
 
 
+def test_worker_ops_are_registry_owned_and_covered():
+    """The verify-worker op set lives in the registry (cc.WORKER_OPS), so
+    the compile lane's `only` filter and the pool's warm-coverage story
+    stay in lockstep: every worker op must have a device-family spec in
+    any profile, and worker_specs must select exactly those."""
+    specs = cc.worker_specs(cc.BENCH)
+    assert specs, "worker op set must be covered by the registry"
+    assert {s.op for s in specs} == set(cc.WORKER_OPS)
+    assert all(s.family == "device" for s in specs)
+    names = {s.name for s in cc.build_registry(cc.BENCH)}
+    assert {s.name for s in specs} <= names
+
+
 # -- stub plumbing -----------------------------------------------------------
 
 class _FakeVNs:
@@ -79,19 +93,26 @@ class _FakeCluster:
         self.dlog = types.SimpleNamespace(limit=4000)
         self._proof_device_lock = threading.Lock()
         self.executed: list = []
+        self.exec_kwargs: list = []
         self.finalized: list = []
         self.fail_encode: set = set()
+        self.fail_encode_once: set = set()
 
     def _ranges_per_value(self, q):
         return [(4, 2)]
 
-    def execute_survey(self, sq, seed=0, hold_range=False):
+    def execute_survey(self, sq, seed=0, hold_range=False,
+                       tenant="default", responders=None):
         self.executed.append((sq.survey_id, hold_range,
                               threading.current_thread().name))
+        self.exec_kwargs.append((sq.survey_id, tenant, responders))
         if sq.survey_id in self.fail_encode:
+            if sq.survey_id in self.fail_encode_once:
+                self.fail_encode.discard(sq.survey_id)
+                self.fail_encode_once.discard(sq.survey_id)
             raise RuntimeError(f"boom {sq.survey_id}")
         return types.SimpleNamespace(
-            sq=sq, hold_range=hold_range,
+            sq=sq, hold_range=hold_range, tenant=tenant,
             survey=types.SimpleNamespace(proof_threads=[]))
 
     def finalize_survey(self, pending):
@@ -252,6 +273,204 @@ def test_pipeline_mode_verifies_on_the_worker_thread(no_compile):
     # encode on the drain (main) thread, verify on the named worker
     assert {t for _, _, t in cl.executed} == {"MainThread"}
     assert {t for _, t in cl.finalized} == {"server-verify"}
+
+
+# -- saturation serving: quotas, DRR, shedding, the worker pool, resume ------
+
+def test_quota_exceeded_is_typed_and_per_tenant(no_compile):
+    srv = _warm_server(_FakeCluster(), max_depth=16, tenant_quota=2,
+                       pipeline=False)
+    srv.submit(_sq("a0"), tenant="a")
+    srv.submit(_sq("a1"), tenant="a")
+    with pytest.raises(QuotaExceeded, match="a2") as ei:
+        srv.submit(_sq("a2"), tenant="a")
+    assert ei.value.tenant == "a" and ei.value.quota == 2
+    assert not isinstance(ei.value, QueueFull)  # distinct typed rejections
+    # another tenant is unaffected by a's quota
+    srv.submit(_sq("b0"), tenant="b")
+    # draining frees a's quota again
+    srv.drain()
+    srv.submit(_sq("a2"), tenant="a")
+
+
+def test_queue_full_beats_quota_and_shed_at_max_depth(no_compile):
+    # max_depth is the hard bound: at depth 2 the error is QueueFull even
+    # though tenant "a" is also past any would-be shed threshold
+    srv = _warm_server(_FakeCluster(), max_depth=2, tenant_quota=8,
+                       pipeline=False)
+    srv.submit(_sq("s0"), tenant="a")
+    srv.submit(_sq("s1"), tenant="a")
+    with pytest.raises(QueueFull):
+        srv.submit(_sq("s2"), tenant="a")
+
+
+def test_drr_ordering_is_deterministic_across_servers(no_compile):
+    """Two identically-configured servers fed the same interleaved
+    multi-tenant stream must execute in the same (DRR-predicted)
+    order: a gets its max_batch quantum, then b, then c, then back
+    to a's remainder."""
+    order = [("a0", "a"), ("a1", "a"), ("b0", "b"), ("a2", "a"),
+             ("c0", "c"), ("b1", "b"), ("a3", "a")]
+    executed = []
+    for _ in range(2):
+        cl = _FakeCluster()
+        srv = _warm_server(cl, max_batch=2, max_depth=16, tenant_quota=8,
+                           pipeline=False)
+        for sid, tenant in order:
+            srv.submit(_sq(sid), tenant=tenant)
+        srv.drain()
+        executed.append([sid for sid, _, _ in cl.executed])
+    assert executed[0] == executed[1]
+    assert executed[0] == ["a0", "a1", "b0", "b1", "c0", "a2", "a3"]
+
+
+def test_hot_tenant_cannot_starve_the_rest(no_compile):
+    cl = _FakeCluster()
+    srv = _warm_server(cl, max_batch=2, max_depth=32, tenant_quota=16,
+                       pipeline=False)
+    for i in range(8):
+        srv.submit(_sq(f"h{i}"), tenant="hot")
+    srv.submit(_sq("v0"), tenant="victim")
+    srv.drain()
+    sids = [sid for sid, _, _ in cl.executed]
+    # the victim ran right after hot's first quantum, not after its 8
+    assert sids.index("v0") == 2, sids
+
+
+def test_shed_rejects_with_retry_after_hint_and_drops_nothing(no_compile):
+    from drynx_tpu.resilience import policy as rp
+
+    # max_depth=8, shed fraction 0.75 -> shed past depth 6
+    srv = _warm_server(_FakeCluster(), max_depth=8, tenant_quota=8,
+                       shed_fraction=0.75, pipeline=False)
+    for i in range(6):
+        srv.submit(_sq(f"s{i}"))
+    with pytest.raises(Overloaded, match="s6") as ei:
+        srv.submit(_sq("s6"))
+    # cold server (no completion rate yet): the hint is the clamp max
+    assert ei.value.retry_after_s == rp.SHED_RETRY_MAX_S
+    results = srv.drain()
+    # shed never drops admitted work: all six completed
+    assert sorted(results) == [f"s{i}" for i in range(6)]
+    assert not any(isinstance(r, Exception) for r in results.values())
+    # with completions observed, the hint is rate-derived and clamped
+    for i in range(6):
+        srv.submit(_sq(f"t{i}"))
+    with pytest.raises(Overloaded) as ei2:
+        srv.submit(_sq("t6"))
+    assert rp.SHED_RETRY_MIN_S <= ei2.value.retry_after_s \
+        <= rp.SHED_RETRY_MAX_S
+
+
+def test_shed_fraction_one_disables_shedding(no_compile):
+    srv = _warm_server(_FakeCluster(), max_depth=4, tenant_quota=8,
+                       shed_fraction=1.0, pipeline=False)
+    for i in range(4):
+        srv.submit(_sq(f"s{i}"))  # no Overloaded below max_depth
+    with pytest.raises(QueueFull):
+        srv.submit(_sq("s4"))
+
+
+def test_worker_pool_spawns_n_named_workers(no_compile):
+    cl = _FakeCluster()
+    srv = _warm_server(cl, max_batch=2, max_depth=16, tenant_quota=16,
+                       pipeline=True, workers=3)
+    for i in range(6):
+        srv.submit(_sq(f"s{i}"))
+    results = srv.drain()
+    assert sorted(results) == [f"s{i}" for i in range(6)]
+    assert [t.name for t in srv._workers] == [
+        "server-verify", "server-verify-1", "server-verify-2"]
+    # every finalize ran on a pool thread, never the drain thread
+    names = {t for _, t in cl.finalized}
+    assert names <= {"server-verify", "server-verify-1", "server-verify-2"}
+
+
+def test_worker_pool_results_match_single_worker(no_compile):
+    outs = []
+    for w in (1, 3):
+        cl = _FakeCluster()
+        srv = _warm_server(cl, max_batch=2, pipeline=True, workers=w,
+                           tenant_quota=16)
+        for i in range(6):
+            srv.submit(_sq(f"s{i}"))
+        outs.append((srv.drain(), sorted(map(sorted, cl.vns.flushed))))
+    assert outs[0] == outs[1]
+
+
+def test_resume_requeues_exactly_once_with_probed_responders(no_compile):
+    cl = _FakeCluster()
+    cl.fail_encode.add("s1")
+    cl.fail_encode_once.add("s1")  # transient: second attempt succeeds
+    cl.probe_liveness = lambda: {"dp0": True, "dp1": False}
+    srv = _warm_server(cl, max_batch=3, pipeline=False)
+    for i in range(3):
+        srv.submit(_sq(f"s{i}"))
+    results = srv.drain()
+    # the retried survey completed like a clean run
+    assert results == {f"s{i}": f"result-s{i}" for i in range(3)}
+    # first attempt unrestricted; the retry carried the probed live set
+    attempts = [(sid, resp) for sid, _, resp in cl.exec_kwargs
+                if sid == "s1"]
+    assert attempts == [("s1", None), ("s1", ("dp0",))]
+    # batch partners flushed without waiting on the retry; the retried
+    # survey re-entered alone
+    assert cl.vns.flushed == [["s0", "s2"]]
+
+
+def test_resume_gives_up_after_max_retries(no_compile):
+    from drynx_tpu.resilience import policy as rp
+
+    cl = _FakeCluster()
+    cl.fail_encode.add("s0")  # persistent failure: every attempt raises
+    srv = _warm_server(cl, pipeline=False)
+    srv.submit(_sq("s0"))
+    results = srv.drain()
+    assert isinstance(results["s0"], RuntimeError)
+    attempts = [sid for sid, _, _ in cl.exec_kwargs if sid == "s0"]
+    assert len(attempts) == 1 + rp.RESUME_MAX_RETRIES
+
+
+def test_resume_e2e_transient_refusal_equals_clean_run():
+    """Real LocalCluster (proofs off): a one-shot connect refusal on dp1
+    fails the first dispatch's quorum, the resume slice re-probes (the
+    refusal is spent), re-enters the queue once, and the retried result
+    equals an undisturbed run's."""
+    from drynx_tpu.resilience import faults
+    from drynx_tpu.service.service import LocalCluster
+
+    def boot():
+        cl = LocalCluster(n_cns=1, n_dps=2, n_vns=0, seed=23,
+                          dlog_limit=1000)
+        rng = np.random.default_rng(9)
+        for name, dp in cl.dps.items():
+            dp.data = rng.integers(0, 5, size=(3,)).astype(np.int64)
+        return cl
+
+    def q(cl, sid):
+        return cl.generate_survey_query("sum", query_min=0, query_max=9,
+                                        proofs=0, survey_id=sid)
+
+    clean = boot()
+    srv0 = SurveyServer(clean, pipeline=False)
+    srv0.submit(q(clean, "r0"))
+    baseline = srv0.drain()["r0"].result
+
+    plan = faults.FaultPlan(seed=0)
+    plan.add(faults.FaultSpec(where="connect", kind="refuse",
+                              target="dp1", count=1))
+    faults.set_fault_plan(plan)
+    try:
+        cl = boot()
+        srv = SurveyServer(cl, pipeline=False)
+        srv.submit(q(cl, "r1"))
+        res = srv.drain()["r1"]
+    finally:
+        faults.set_fault_plan(None)
+    assert not isinstance(res, Exception), res
+    assert res.result == baseline
+    # the retry saw both DPs again: full membership, nothing absent
+    assert res.responders == ["dp0", "dp1"] and res.absent == []
 
 
 # -- VN cross-flush: tampered neighbor isolation -----------------------------
@@ -461,6 +680,19 @@ def test_server_end_to_end_batched_equals_serial():
     for sid in ("s0", "s1", "s2"):
         assert results2[sid].result == expected
         assert survey_transcript(cl2.vns, sid) == batched[sid], sid
+
+    # and the multi-worker pool: same seeds through a 2-worker verify
+    # pool — the cross-survey flush is grouping-invariant, so the
+    # transcripts stay byte-identical to both references
+    cl3, _ = _proofs_cluster(seed=13, data_seed=5)
+    srv3 = SurveyServer(cl3, max_batch=3, pipeline=True, workers=2)
+    srv3.prewarm(_queries(cl3)[0])
+    for sq in _queries(cl3):
+        srv3.submit(sq)
+    results3 = srv3.drain()
+    for sid in ("s0", "s1", "s2"):
+        assert results3[sid].result == expected
+        assert survey_transcript(cl3.vns, sid) == batched[sid], sid
 
 
 @pytest.mark.slow
