@@ -147,6 +147,74 @@ class ImplicitDtype(Rule):
 
 # ---------------------------------------------------------------------------
 @register
+class HostRoundtripInDecode(Rule):
+    """A value materialized on the host with ``np.asarray(...)`` and
+    immediately re-uploaded via ``jnp.asarray`` / ``jax.device_put`` is
+    the host round-trip the device-direct data path removed: the wire /
+    staging layers (service/, parallel/) should hand device consumers a
+    device array directly (transport.unpack_array_device, proof_plane
+    put_shard) instead of copying through host memory. Flags the nested
+    form ``jnp.asarray(np.asarray(x))`` and the two-statement form
+    ``v = np.asarray(...)`` followed by ``jnp.asarray(v)``."""
+
+    id = "host-roundtrip-in-decode"
+    summary = ("np.asarray(...) immediately re-uploaded with jnp.asarray/"
+               "device_put inside service/ or parallel/ — use the "
+               "device-direct decode path instead of a host round-trip")
+
+    _HOST = {"np.asarray", "numpy.asarray"}
+    _DEVICE = {"jnp.asarray", "jax.numpy.asarray", "jax.device_put",
+               "device_put"}
+
+    def _is_host_call(self, node) -> bool:
+        return (isinstance(node, ast.Call)
+                and _dotted(node.func) in self._HOST)
+
+    def run(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not (_is_drynx_pkg(mod)
+                and _in_scope(mod, "service", "parallel")):
+            return
+        for sub in ast.walk(mod.tree):
+            # nested form: device sink taking a host materialization as
+            # its first argument
+            if isinstance(sub, ast.Call) \
+                    and _dotted(sub.func) in self._DEVICE \
+                    and sub.args and self._is_host_call(sub.args[0]):
+                yield self.finding(
+                    mod, sub,
+                    f"'{_dotted(sub.func)}(np.asarray(...))' round-trips "
+                    f"through host memory — decode/stage straight to "
+                    f"device (unpack_array_device / put_shard)")
+            # two-statement form: v = np.asarray(...); <device sink>(v)
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(sub, field, None)
+                if not isinstance(stmts, list):
+                    continue
+                for prev, nxt in zip(stmts, stmts[1:]):
+                    if not (isinstance(prev, ast.Assign)
+                            and len(prev.targets) == 1
+                            and isinstance(prev.targets[0], ast.Name)
+                            and self._is_host_call(prev.value)):
+                        continue
+                    name = prev.targets[0].id
+                    for call in ast.walk(nxt):
+                        if isinstance(call, ast.Call) \
+                                and _dotted(call.func) in self._DEVICE \
+                                and call.args \
+                                and isinstance(call.args[0], ast.Name) \
+                                and call.args[0].id == name:
+                            yield self.finding(
+                                mod, call,
+                                f"'{name} = np.asarray(...)' is "
+                                f"immediately re-uploaded by "
+                                f"'{_dotted(call.func)}({name})' — a "
+                                f"host round-trip the device-direct "
+                                f"path avoids")
+                            break
+
+
+# ---------------------------------------------------------------------------
+@register
 class HostSyncInHotPath(ProjectRule):
     """Inside jit-traced crypto/parallel code, float()/int()/bool()/
     np.asarray() on a traced value either crashes at trace time or forces a

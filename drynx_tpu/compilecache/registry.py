@@ -733,6 +733,36 @@ def _pool_specs(p: Profile) -> list:
     return specs
 
 
+# canonical flat width the wire widen programs lower at: the program is
+# elementwise so any width certifies the pipeline; 4096 matches the pool
+# slab width (the largest steady-state wire tensor)
+_WIRE_WIDEN_FLAT = 4096
+
+
+def _wire_specs(p: Profile) -> list:
+    """The device-direct decode's on-device widen programs: one jitted
+    astype per (narrow, wide) integer dtype pair the v2 wire can ship
+    (transport.widen_pairs). Profile-independent — every survey decodes
+    frames — so they appear in every registry and never perturb the
+    subset/identity contracts of the optional axes."""
+    from ..service import transport as T
+
+    specs = []
+    for narrow, wide in T.widen_pairs():
+        def th(do="lower", narrow=narrow, wide=wide):
+            from ..service import transport as T
+
+            prog = T.widen_program(narrow, wide)
+            arg = _z((_WIRE_WIDEN_FLAT,), narrow)
+            return prog(arg) if do == "call" else prog.lower(arg)
+
+        specs.append(ProgramSpec(
+            f"wire:widen@{narrow}->{wide}", "widen", "wire",
+            "WireDecode", th, lambda: True,
+            lambda th=th: th("call"), family="device"))
+    return specs
+
+
 def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     """Enumerate the proofs-on program set for `profile`.
 
@@ -777,7 +807,7 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
             specs[name] = ProgramSpec(name, op, "bucketed", phase, lower,
                                       _GATES[gate], call, family=gate)
     for s in (_pallas_specs(profile) + _fused_specs(profile)
-              + _pool_specs(profile)):
+              + _pool_specs(profile) + _wire_specs(profile)):
         specs[s.name] = s
     return list(specs.values())
 

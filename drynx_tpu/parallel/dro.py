@@ -192,14 +192,16 @@ def _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk: int, phase: str):
         return eg.encrypt_with_tables(base_tbl, pub_tbl,
                                       eg.int_to_scalar(zeros), r)
 
-    def slab(i, a, b):
-        rs = plane.put_shard(r[a:b], i)
+    def stage(i, a, b):
+        return a, b, plane.put_shard(r[a:b], i, donate=True)
+
+    def slab(i, a, b, rs):
         zeros = jnp.zeros((b - a,), dtype=jnp.int64)
         return eg.encrypt_with_tables(base_tbl, pub_tbl,
                                       eg.int_to_scalar(zeros), rs)
 
     slabs = [(a, min(a + eff, size)) for a in range(0, size, eff)]
-    parts = plane.dispatch_shards(phase, slab, slabs)
+    parts = plane.dispatch_shards(phase, slab, slabs, prefetch=stage)
     return jnp.concatenate(parts, axis=0)
 
 
@@ -277,15 +279,18 @@ def shuffle_rerandomize(key, cts, pub_tbl, base_tbl=None, precomp=None,
 
     perm_h = np.asarray(perm)
 
-    def slab(i, a, b):
+    def stage(i, a, b):
         # exact global permutation: host-permuted indices, per-slab gather
-        gathered, zc = plane.put_shard(
+        return plane.put_shard(
             (jnp.take(cts, jnp.asarray(perm_h[a:b]), axis=0),
-             zero_ct[a:b]), i)
+             zero_ct[a:b]), i, donate=True)
+
+    def slab(i, gathered, zc):
         return eg.ct_add(gathered, zc)
 
     slabs = [(a, min(a + eff, S)) for a in range(0, S, eff)]
-    parts = plane.dispatch_shards("DROShuffle", slab, slabs)
+    parts = plane.dispatch_shards("DROShuffle", slab, slabs,
+                                  prefetch=stage)
     return jnp.concatenate(parts, axis=0), perm, r
 
 

@@ -205,9 +205,15 @@ def rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
     px, py, qx, qy, ca, rr = map(flat, (px, py, qx, qy, conj_a, r))
     slices = plane.shard_slices(N, n_shards)
 
-    def shard_total(i, a, b):
-        spx, spy, sqx, sqy, sca, srr = plane.put_shard(
-            (px[a:b], py[a:b], qx[a:b], qy[a:b], ca[a:b], rr[a:b]), i)
+    def stage_total(i, a, b):
+        # input staging: the per-shard slices are one-shot, so their
+        # buffers are donated to the upload (reused where the backend
+        # can alias); uploads overlap the previous shard's compute
+        return plane.put_shard(
+            (px[a:b], py[a:b], qx[a:b], qy[a:b], ca[a:b], rr[a:b]), i,
+            donate=True)
+
+    def shard_total(i, spx, spy, sqx, sqy, sca, srr):
         m = B.miller(spx, spy, sqx, sqy)
         # 63-bit windowed pow — same program the single-device verifier
         # uses for the 62-bit RLC weights; a passed the prelude's
@@ -218,7 +224,8 @@ def rlc_total_shards(proof, sigs_pub, r_int, gtb_pow_s,
                 B.gt_reduce_prod(ar.reshape(-1, 6, 2, nl)))
 
     parts = plane.dispatch_shards(
-        phase, shard_total, [(a, b) for (a, b) in slices])
+        phase, shard_total, [(a, b) for (a, b) in slices],
+        prefetch=stage_total)
     # combine partials exactly as the single-device path combines its two
     # full-batch products: final_exp on the Miller product ONLY, then the
     # a-product and the gtB power fold in with plain GT muls

@@ -44,6 +44,11 @@ from ..utils.timers import PhaseTimers
 
 ENV_FLAG = "DRYNX_PROOF_PLANE"
 
+# Async shard pipeline kill-switch: "serial"/"off" restores the
+# block-per-shard dispatch loop (the pre-device-path behavior the
+# bench_device_path supervisor compares against).
+ASYNC_ENV = "DRYNX_ASYNC_DISPATCH"
+
 # Batches smaller than this never shard: the per-shard dispatch overhead
 # (host_dispatch flatten + jit cache lookup per shard) would exceed the
 # per-element work of a handful of digit proofs.
@@ -105,23 +110,57 @@ def shard_device(i: int):
     return devs[i % len(devs)]
 
 
-def put_shard(tree, i: int):
-    """Place one shard's arrays on mesh device i (identity off-mesh)."""
+def async_on() -> bool:
+    """True iff dispatch_shards pipelines: never blocks between enqueues,
+    one block_until_ready barrier at the end. DRYNX_ASYNC_DISPATCH=serial
+    (or off/0/no) restores the per-shard blocking loop."""
+    return os.environ.get(ASYNC_ENV,
+                          "").strip().lower() not in ("serial", "off",
+                                                      "0", "no")
+
+
+def _put_leaf(x, dev, donate: bool):
+    import jax
+
+    # identity fast-path: already committed to the target device — a
+    # device_put here would be a redundant copy on every shard hop
+    if getattr(x, "device", None) == dev:
+        return x
+    if donate:
+        try:
+            return jax.device_put(x, dev, donate=True)
+        except TypeError:       # older jax without the donate kwarg
+            pass
+    return jax.device_put(x, dev)
+
+
+def put_shard(tree, i: int, donate: bool = False):
+    """Place one shard's arrays on mesh device i (identity off-mesh and
+    on single-device hosts). ``donate`` hands the source buffers to the
+    transfer — safe only for arrays the caller never reads again (the
+    per-shard input slices); backends that cannot alias simply copy."""
     if not placement_on():
         return tree
     import jax
 
-    return jax.device_put(tree, shard_device(i))
+    dev = shard_device(i)
+    return jax.tree_util.tree_map(
+        lambda x: _put_leaf(x, dev, donate), tree)
 
 
 def gather(tree):
     """Bring per-shard results back to the lead device for the combine /
-    concat ("results gathered once per batch")."""
+    concat ("results gathered once per batch"). Leaves already on the
+    lead device pass through untouched — the consumer and producer share
+    a device, so there is nothing to move."""
     if not placement_on():
         return tree
     import jax
 
-    return jax.device_put(tree, shard_device(0))
+    dev = shard_device(0)
+    return jax.tree_util.tree_map(
+        lambda x: x if getattr(x, "device", None) == dev
+        else jax.device_put(x, dev), tree)
 
 
 def shard_slices(n: int, k: int,
@@ -146,37 +185,70 @@ def record_shard(phase: str, i: int, seconds: float) -> None:
 
 
 def timers_snapshot() -> dict:
-    """{"<Phase>.shard<i>": seconds} accumulated this process."""
+    """{"<Phase>.shard<i>": seconds} accumulated this process, plus the
+    "<Phase>.<stage>#<host_glue|device_compute>" attribution keys."""
     return {k: round(v, 6) for k, v in SHARD_TIMERS.items()}
 
 
-def dispatch_shards(phase: str, fn, shard_args: list) -> list:
-    """Dispatch fn(i, *args_i) for every shard, then block in order.
+def dispatch_shards(phase: str, fn, shard_args: list,
+                    prefetch=None) -> list:
+    """Dispatch fn(i, *args_i) for every shard as a pipeline.
 
-    On an accelerator mesh the dispatches are asynchronous, so shard i+1
-    enqueues while shard i computes — the devices overlap; the recorded
-    per-shard span is dispatch-start -> outputs-ready (on CPU this is the
-    shard's synchronous compute time). Results are gathered to the lead
-    device."""
+    Async mode (default): the dispatch thread never blocks between
+    enqueues — shard i+1's inputs are ``prefetch``-uploaded right after
+    shard i is enqueued (so the upload overlaps shard i's compute on an
+    async backend) and one ``block_until_ready`` barrier at the end waits
+    for the whole batch. ``DRYNX_ASYNC_DISPATCH=serial`` restores the
+    block-per-shard loop (bench comparison / debugging).
+
+    ``prefetch(i, *args_i) -> new_args_i`` is the input-staging stage
+    (put_shard uploads, slicing); when given, ``fn`` receives its return
+    value instead of the raw args. Prefetch time is attributed as
+    host_glue; the barrier as device_compute (on a synchronous backend
+    the fn() span itself is the device compute and is attributed so).
+
+    Results are gathered to the lead device. The per-shard span keys
+    ("<Phase>.shard<i>" dispatch-start -> outputs-ready and
+    "<Phase>.dispatch.shard<i>" for the fn() call) are unchanged."""
     import jax
 
+    serial = not async_on()
+    n = len(shard_args)
+    # fn spans are pure enqueue cost only when placement puts shards on
+    # an async accelerator mesh; on the synchronous host backend the
+    # fn() call runs the shard's kernels to completion
+    fn_kind = "host_glue" if placement_on() else "device_compute"
     outs, t0s = [], []
+    nxt = prefetch(0, *shard_args[0]) if (prefetch and n) else None
     for i, args in enumerate(shard_args):
-        t0s.append(time.perf_counter())
-        out = fn(i, *args)
-        # "<Phase>.dispatch<i>": the fn() call itself. On a synchronous
-        # backend (CPU host-oracle detour) this IS shard i's own compute;
-        # on an async accelerator it is just the enqueue cost.
-        record_shard(f"{phase}.dispatch", i, time.perf_counter() - t0s[i])
+        cur = nxt if prefetch else args
+        t0 = time.perf_counter()
+        t0s.append(t0)
+        out = fn(i, *cur)
+        dt = time.perf_counter() - t0
+        record_shard(f"{phase}.dispatch", i, dt)
+        SHARD_TIMERS.add_split(f"{phase}.enqueue", fn_kind, dt)
         outs.append(out)
-    ready = []
-    for i, o in enumerate(outs):
-        o = jax.block_until_ready(o)
-        record_shard(phase, i, time.perf_counter() - t0s[i])
-        ready.append(gather(o))
-    return ready
+        if prefetch and i + 1 < n:
+            tp = time.perf_counter()
+            nxt = prefetch(i + 1, *shard_args[i + 1])
+            SHARD_TIMERS.add_split(f"{phase}.upload", "host_glue",
+                                   time.perf_counter() - tp)
+        if serial:
+            jax.block_until_ready(out)
+            record_shard(phase, i, time.perf_counter() - t0s[i])
+    if not serial:
+        tb = time.perf_counter()
+        jax.block_until_ready(outs)
+        tend = time.perf_counter()
+        SHARD_TIMERS.add_split(f"{phase}.block", "device_compute",
+                               tend - tb)
+        for i in range(n):
+            record_shard(phase, i, tend - t0s[i])
+    return [gather(o) for o in outs]
 
 
 __all__ = ["enabled", "n_shards", "device_count", "placement_on",
            "shard_slices", "put_shard", "gather", "dispatch_shards",
-           "record_shard", "timers_snapshot", "SHARD_TIMERS", "ENV_FLAG"]
+           "async_on", "record_shard", "timers_snapshot", "SHARD_TIMERS",
+           "ENV_FLAG", "ASYNC_ENV"]

@@ -49,9 +49,68 @@ import hashlib
 import json
 import os
 import secrets
+import struct
 import threading
+import zipfile
 
 import numpy as np
+
+
+def mmap_enabled() -> bool:
+    """``DRYNX_POOL_MMAP=off`` is the kill-switch back to eager slab /
+    sig-table reads (full host copies out of np.load)."""
+    return os.environ.get("DRYNX_POOL_MMAP",
+                          "").strip().lower() not in ("off", "0", "no")
+
+
+def _npz_members(path: str):
+    """{name: (data_offset, dtype, shape, fortran)} for every member of
+    an UNCOMPRESSED npz (np.savez default), or None when any member
+    can't be mapped (compressed, foreign layout, unexpected header)."""
+    out = {}
+    with zipfile.ZipFile(path) as z, open(path, "rb") as f:
+        for zi in z.infolist():
+            if zi.compress_type != zipfile.ZIP_STORED:
+                return None
+            f.seek(zi.header_offset)
+            hdr = f.read(30)
+            if len(hdr) != 30 or hdr[:4] != b"PK\x03\x04":
+                return None
+            # the LOCAL header's name/extra lengths (they can differ
+            # from the central directory's)
+            fn_len, extra_len = struct.unpack("<HH", hdr[26:30])
+            f.seek(zi.header_offset + 30 + fn_len + extra_len)
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, fortran, dt = np.lib.format.read_array_header_1_0(f)
+            elif version == (2, 0):
+                shape, fortran, dt = np.lib.format.read_array_header_2_0(f)
+            else:
+                return None
+            name = zi.filename
+            if name.endswith(".npy"):
+                name = name[:-4]
+            out[name] = (f.tell(), dt, shape, fortran)
+    return out
+
+
+def _load_npz_mapped(path: str):
+    """Read-only np.memmap per member of an npz, straight over the zip
+    at each member's computed data offset — no host materialization;
+    device_put can feed directly from the mapping. None on any surprise
+    (the caller falls back to the eager np.load copy). A Linux mapping
+    survives the file's unlink, so the claim protocol's read-then-unlink
+    ordering is unchanged."""
+    try:
+        members = _npz_members(path)
+        if members is None:
+            return None
+        return {name: np.memmap(path, dtype=dt, mode="r", offset=off,
+                                shape=shape,
+                                order="F" if fortran else "C")
+                for name, (off, dt, shape, fortran) in members.items()}
+    except Exception:
+        return None
 
 
 class PoolError(Exception):
@@ -243,8 +302,15 @@ class CryptoPool:
             self._consumed.add(sid)
             self.counters["consumed"] += 1
             self.counters["elements_consumed"] += _slab_elems(path)
-        with np.load(claimed) as d:
-            out = (d["zero_ct"].copy(), d["r"].copy())
+        mapped = _load_npz_mapped(claimed) if mmap_enabled() else None
+        if mapped is not None and "zero_ct" in mapped and "r" in mapped:
+            # zero-copy serve: the mappings stay valid past the unlink
+            # (the inode lives while mapped) and feed device_put without
+            # ever materializing a full host copy
+            out = (mapped["zero_ct"], mapped["r"])
+        else:
+            with np.load(claimed) as d:
+                out = (d["zero_ct"].copy(), d["r"].copy())
         os.unlink(claimed)
         return out
 
@@ -292,6 +358,10 @@ class CryptoPool:
             # already tombstoned and stays discarded
             raise InsufficientBalance(
                 f"pool drained concurrently: got {got} < need {need}")
+        if len(zs) == 1:
+            # one slab covered the need: serve views of the (possibly
+            # mapped) arrays instead of concatenating a fresh copy
+            return zs[0][:need], rs[0][:need]
         z = np.concatenate(zs, axis=0)[:need]
         r = np.concatenate(rs, axis=0)[:need]
         return z, r
@@ -315,11 +385,16 @@ class CryptoPool:
         _atomic_write_npz(self._sig_path(kind, digest), **arrays)
 
     def load_sig(self, kind: str, digest: str):
+        """Lazy per-key view of the sig-table npz — None when absent.
+
+        Every caller uses exactly one key (range_proof's gt/pow tables,
+        elgamal's fb table), so the old eager {k: copy for all keys}
+        materialized arrays nobody read. Arrays load (mapped when
+        DRYNX_POOL_MMAP is on) on first access and cache per key."""
         p = self._sig_path(kind, digest)
         if not os.path.exists(p):
             return None
-        with np.load(p) as d:
-            return {k: d[k].copy() for k in d.files}
+        return SigTables(p)
 
     # -- stats -------------------------------------------------------------
 
@@ -334,6 +409,48 @@ class CryptoPool:
         }
 
 
+class SigTables:
+    """Lazy mapping over one sig-table npz: each array is read on first
+    access only (np.memmap when DRYNX_POOL_MMAP is on, else an eager
+    per-member np.load read) and cached. Supports the dict surface the
+    sig-table callers use: ``d[key]``, ``in``, ``keys()``, iteration."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._cache: dict = {}
+        self._names = None
+
+    def keys(self):
+        if self._names is None:
+            with zipfile.ZipFile(self._path) as z:
+                self._names = [n[:-4] if n.endswith(".npy") else n
+                               for n in z.namelist()]
+        return list(self._names)
+
+    def __contains__(self, k) -> bool:
+        return k in self.keys()
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    def __getitem__(self, k):
+        if k in self._cache:
+            return self._cache[k]
+        a = None
+        if mmap_enabled():
+            mapped = _load_npz_mapped(self._path)
+            if mapped is not None:
+                a = mapped[k]
+        if a is None:
+            with np.load(self._path) as d:
+                a = d[k].copy()
+        self._cache[k] = a
+        return a
+
+
 def _slab_id(path: str) -> str:
     stem = os.path.basename(path)
     assert stem.startswith("slab_") and stem.endswith(".npz"), path
@@ -346,4 +463,5 @@ def _slab_elems(path: str) -> int:
 
 
 __all__ = ["CryptoPool", "PoolError", "DoubleConsumption",
-           "InsufficientBalance", "key_digest"]
+           "InsufficientBalance", "key_digest", "SigTables",
+           "mmap_enabled"]

@@ -697,17 +697,23 @@ def _commit_kernel_sharded(digits, s, t, m, v, A_tab, ca_tbl, u: int, l: int,
         return _commit_kernel(digits, s, t, m, v, A_tab, ca_tbl, u, l,
                               gtA=gtA, gtA_pow=gtA_pow)
 
-    def shard_commit(i, a, b):
+    def stage_commit(i, a, b):
         # only the per-shard slices are committed to shard i's device; the
         # shared tables (base/ca/A/gtA) stay uncommitted and follow the
-        # committed operands onto each shard's device
-        sd, ss, st, sm, sv = plane.put_shard(
-            (digits[a:b], s[a:b], t[a:b], m[a:b], v[:, a:b]), i)
+        # committed operands onto each shard's device. The slices are
+        # one-shot, so their buffers are donated to the upload; staging
+        # overlaps the previous shard's compute (dispatch_shards).
+        return plane.put_shard(
+            (digits[a:b], s[a:b], t[a:b], m[a:b], v[:, a:b]), i,
+            donate=True)
+
+    def shard_commit(i, sd, ss, st, sm, sv):
         return _commit_kernel(sd, ss, st, sm, sv, A_tab, ca_tbl, u, l,
                               gtA=gtA, gtA_pow=gtA_pow)
 
     parts = plane.dispatch_shards(
-        "CreateShard", shard_commit, [(a, b) for (a, b) in slices])
+        "CreateShard", shard_commit, [(a, b) for (a, b) in slices],
+        prefetch=stage_commit)
     D = jnp.concatenate([p[0] for p in parts], axis=0)
     m_tot = jnp.concatenate([p[1] for p in parts], axis=0)
     V_pts = jnp.concatenate([p[2] for p in parts], axis=1)

@@ -66,7 +66,7 @@ from .skipchain import DataBlock
 from .transport import (ConnectError, Conn, NodeServer, RemoteError,
                         TransportError, conn_pool, current_node,
                         link_model, pack_array, set_current_node,
-                        unpack_array)
+                        unpack_array, unpack_array_device)
 
 
 def _net_delta(before: dict, after: dict) -> dict:
@@ -720,7 +720,7 @@ class DrynxNode:
     # fresh secret scalar (reference obfuscation_protocol.go:241-243) and
     # prove it (lib/obfuscation/obfuscation_proof.go:47)
     def _h_obf_contrib(self, msg: dict) -> dict:
-        cts = jnp.asarray(unpack_array(msg["cts"]))
+        cts = unpack_array_device(msg["cts"])
         V = cts.shape[0]
         key = jax.random.PRNGKey(secrets.randbits(63))
         k_s, k_w = jax.random.split(key)
@@ -737,7 +737,7 @@ class DrynxNode:
     # -- CN side: DRO shuffle contribution (reference unlynx shuffling
     # protocol with proof, SURVEY.md §2.2; Neff-style argument)
     def _h_shuffle_contrib(self, msg: dict) -> dict:
-        cts = jnp.asarray(unpack_array(msg["cts"]))
+        cts = unpack_array_device(msg["cts"])
         coll_pub = self.roster.collective_pub()
         tbl = self._pub_table(coll_pub)
         key = jax.random.PRNGKey(secrets.randbits(63))
@@ -780,7 +780,7 @@ class DrynxNode:
     # a per-CN keyswitch proof (ns=1 batch) goes to the VNs (reference
     # service.go:566-616 proof hook)
     def _h_ks_contrib(self, msg: dict) -> dict:
-        K0 = jnp.asarray(unpack_array(msg["k_component"]))   # (V, 3, 16)
+        K0 = unpack_array_device(msg["k_component"])   # (V, 3, 16)
         client_pub = tuple(msg["client_pub"])
         q_tbl = self._pub_table(client_pub)
         V = K0.shape[0]
@@ -1013,7 +1013,7 @@ class DrynxNode:
                                       "survey_id": survey_id,
                                       "proofs": proofs,
                                       "cts": pack_array(np.asarray(agg))})
-                agg = jnp.asarray(unpack_array(r["cts"]))
+                agg = unpack_array_device(r["cts"])
 
         # DRO / differential-privacy noise: root builds the encrypted noise
         # list, every CN shuffles + re-randomizes it in turn, one noise ct
@@ -1032,7 +1032,7 @@ class DrynxNode:
                                       "survey_id": survey_id,
                                       "proofs": proofs,
                                       "cts": pack_array(np.asarray(n_cts))})
-                n_cts = jnp.asarray(unpack_array(r["cts"]))
+                n_cts = unpack_array_device(r["cts"])
             V = int(agg.shape[0])
             idx = np.arange(V) % int(n_cts.shape[0])
             agg = B.ct_add(agg, jnp.take(n_cts, jnp.asarray(idx), axis=0))
@@ -1049,8 +1049,8 @@ class DrynxNode:
         for e, (r, err) in zip(cns, outs):
             if err is not None:
                 raise err
-            u = jnp.asarray(unpack_array(r["u"]))
-            w = jnp.asarray(unpack_array(r["w"]))
+            u = unpack_array_device(r["u"])
+            w = unpack_array_device(r["w"])
             k_sum = u if k_sum is None else B.g1_add(k_sum, u)
             c_sum = w if c_sum is None else B.g1_add(c_sum, w)
 
@@ -1650,7 +1650,7 @@ class RemoteClient:
                        timeout=max(timeout, rp.CALL_TIMEOUT_S))
         self.last_responders = list(r.get("responders") or [])
         self.last_absent = list(r.get("absent") or [])
-        switched = jnp.asarray(unpack_array(r["switched"]))
+        switched = unpack_array_device(r["switched"])
         dl = dlog or eg.DecryptionTable(limit=10000)
         xq = jnp.asarray(eg.secret_to_limbs(self.secret))
         pts = B.decrypt_point(switched, xq)

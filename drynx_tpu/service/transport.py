@@ -19,6 +19,12 @@ Two wire formats share one outer framing ([u32 length][body]):
                {"__seg__": i}. No base64, no JSON-escaping of payload
                bytes.
 
+v2 decode is *device-direct* by default: narrowed integer segments stay
+lazy (:class:`LazySeg`) so device-bound handlers upload the raw wire
+view and widen on device (``unpack_array_device``), while host consumers
+widen on demand to the exact legacy bytes. ``DRYNX_DEVICE_DECODE=off``
+restores the eager host widen.
+
 The format is negotiated per connection: a client opens in v1, sends a
 ``wire_hello`` (handled inside the server accept loop, invisible to the
 fault plan and to handlers), and switches to the agreed version. An old
@@ -224,7 +230,10 @@ def b64(data: bytes) -> str:
 
 def unb64(s) -> bytes:
     """Binary field decoder, wire-agnostic: v1 delivers base64 strings,
-    v2 delivers raw bytes segments. Handlers call this and never care."""
+    v2 delivers raw bytes segments (possibly lazy narrowed ones).
+    Handlers call this and never care."""
+    if isinstance(s, LazySeg):
+        return s.to_bytes()
     if isinstance(s, (bytes, bytearray, memoryview)):
         return bytes(s)
     return base64.b64decode(s.encode())
@@ -244,6 +253,157 @@ def unpack_array(d: dict) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Device-direct decode (wire -> device without the host widen)
+# ---------------------------------------------------------------------------
+
+def device_decode_on() -> bool:
+    """``DRYNX_DEVICE_DECODE=off`` is the kill-switch back to the host
+    decode path (narrowed segments widened via numpy before any handler
+    sees them)."""
+    return os.environ.get("DRYNX_DEVICE_DECODE",
+                          "").strip().lower() not in ("off", "0", "no")
+
+
+class LazySeg:
+    """A narrowed v2 segment whose dtype widen has not happened yet.
+
+    Host consumers (``unb64`` / ``unpack_array``) widen on demand and see
+    bytes identical to the legacy decode; device consumers
+    (``unpack_array_device``) skip the host widen entirely — the narrow
+    view uploads as-is and a registered widen program restores the
+    original dtype as the first on-device op."""
+
+    __slots__ = ("raw", "wire_dt", "orig_dt", "_wide")
+
+    def __init__(self, raw: bytes, wire_dt: str, orig_dt: str):
+        self.raw = raw
+        self.wire_dt = wire_dt
+        self.orig_dt = orig_dt
+        self._wide: Optional[bytes] = None
+
+    def narrow_view(self) -> np.ndarray:
+        """Zero-copy 1-D view of the wire bytes at the wire dtype."""
+        return np.frombuffer(self.raw, dtype=np.dtype(self.wire_dt))
+
+    def to_bytes(self) -> bytes:
+        """Host-widened bytes — exactly what the legacy decoder produced."""
+        if self._wide is None:
+            self._wide = self.narrow_view() \
+                .astype(np.dtype(self.orig_dt)).tobytes()
+        return self._wide
+
+    def __len__(self) -> int:
+        return len(self.raw) // np.dtype(self.wire_dt).itemsize \
+            * np.dtype(self.orig_dt).itemsize
+
+    def __eq__(self, other) -> bool:
+        # value-equal to the widened bytes, so decoded trees compare
+        # equal to the original payload regardless of decode mode
+        if isinstance(other, (bytes, bytearray)):
+            return self.to_bytes() == bytes(other)
+        if isinstance(other, LazySeg):
+            return self.to_bytes() == other.to_bytes()
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self.to_bytes())
+
+    def __repr__(self) -> str:
+        return (f"LazySeg({len(self.raw)}B {self.wire_dt}"
+                f"->{self.orig_dt})")
+
+
+def widen_pairs() -> list:
+    """Every (narrow, wide) integer dtype pair the v2 encoder can ship —
+    the set of on-device widen programs the compilecache registry
+    certifies (registry._wire_specs)."""
+    out = []
+    for kind, cands in _NARROW.items():
+        for size in (2, 4, 8):
+            wide = np.dtype(f"{kind}{size}")
+            for cand in cands:
+                cdt = np.dtype(cand)
+                if cdt.itemsize < wide.itemsize:
+                    out.append((cdt.name, wide.name))
+    return out
+
+
+_WIDEN_JITS: dict = {}
+
+
+def widen_program(wire_name: str, orig_name: str):
+    """The registered on-device widen: a jitted astype per (narrow, wide)
+    dtype pair. Integer astype zero-/sign-extends exactly like the numpy
+    host widen, so the device path is byte-identical."""
+    key = (wire_name, orig_name)
+    fn = _WIDEN_JITS.get(key)
+    if fn is None:
+        import jax
+
+        def _widen(a, _dt=orig_name):
+            return a.astype(_dt)
+
+        fn = jax.jit(_widen)
+        _WIDEN_JITS[key] = fn
+    return fn
+
+
+_DEVICE_MIN_DEFAULT = 1 << 16
+
+
+def device_decode_min_bytes() -> int:
+    """Wire-byte floor below which a narrowed segment widens on the host
+    even in device-decode mode: the on-device widen costs two extra op
+    dispatches (upload + widen program), ~1 ms on the CPU backend —
+    cheaper than the host astype only once the segment is large enough
+    to amortize them (and, on a real accelerator, large enough that
+    shipping half the bytes over PCIe matters). BENCH_DEVPATH_r01
+    measured the unthresholded path costing ~10x on small proof
+    payloads. ``DRYNX_DEVICE_DECODE_MIN=0`` forces the device widen for
+    every narrowed segment."""
+    try:
+        return int(os.environ.get("DRYNX_DEVICE_DECODE_MIN",
+                                  _DEVICE_MIN_DEFAULT))
+    except ValueError:
+        return _DEVICE_MIN_DEFAULT
+
+
+def unpack_array_device(d: dict):
+    """Tensor field -> device array of the packed dtype/shape.
+
+    The device-direct decode: a narrowed segment at or above
+    ``device_decode_min_bytes()`` uploads its raw wire view (no
+    intermediate host widen/copy) and widens on device through the
+    registered program; anything else takes one ``jnp.asarray`` over
+    the (cached) host widen. Values equal
+    ``jnp.asarray(unpack_array(d))`` bit-for-bit either way."""
+    import jax.numpy as jnp
+
+    data = d["data"]
+    t0 = time.perf_counter()
+    if isinstance(data, LazySeg) and \
+            len(data.raw) >= device_decode_min_bytes():
+        dev = jnp.asarray(data.narrow_view())
+        out = widen_program(data.wire_dt,
+                            data.orig_dt)(dev).reshape(d["shape"])
+    else:
+        out = jnp.asarray(unpack_array(d))
+    _record_glue("WireUpload", time.perf_counter() - t0)
+    return out
+
+
+def _record_glue(phase: str, dt: float) -> None:
+    """Attribute a transport span to the shared host_glue/device_compute
+    ledger (parallel.proof_plane.SHARD_TIMERS); never fails the wire."""
+    try:
+        from ..parallel import proof_plane as plane
+
+        plane.SHARD_TIMERS.add_split(phase, "host_glue", dt)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # Wire formats
 # ---------------------------------------------------------------------------
 
@@ -259,6 +419,8 @@ def wire_default() -> int:
 def _json_default(o):
     """v1 compatibility hook: bytes fields become base64 strings, exactly
     the shape the pre-v2 wire shipped."""
+    if isinstance(o, LazySeg):
+        return b64(o.to_bytes())
     if isinstance(o, (bytes, bytearray, memoryview)):
         return b64(bytes(o))
     raise TypeError(f"not JSON-serializable: {type(o).__name__}")
@@ -319,10 +481,21 @@ def _encode_v2(obj: dict) -> bytes:
     def strip(o):
         if isinstance(o, (bytes, bytearray, memoryview)):
             return ref(bytes(o))
+        if isinstance(o, LazySeg):
+            # relayed narrowed segment: forward the narrow wire bytes
+            # untouched with the same widen marker — no host widen, and
+            # byte-identical to re-narrowing the widened bytes
+            return ref(o.raw, [o.wire_dt, o.orig_dt])
         if isinstance(o, dict):
-            if isinstance(o.get("data"), (bytes, bytearray, memoryview)) \
+            if isinstance(o.get("data"),
+                          (bytes, bytearray, memoryview, LazySeg)) \
                     and isinstance(o.get("dtype"), str):
-                wire_bytes, wdt = _narrow_seg(o["dtype"], bytes(o["data"]))
+                if isinstance(o["data"], LazySeg):
+                    wire_bytes = o["data"].raw
+                    wdt = o["data"].wire_dt
+                else:
+                    wire_bytes, wdt = _narrow_seg(o["dtype"],
+                                                  bytes(o["data"]))
                 nw = [wdt, o["dtype"]] if wdt else None
                 return {k: (ref(wire_bytes, nw) if k == "data"
                             else strip(v)) for k, v in o.items()}
@@ -364,6 +537,8 @@ def _decode_v2(body: bytes) -> dict:
             segs.append(body[off:off + n])
             off += n
 
+        lazy = device_decode_on()
+
         def fill(o):
             if isinstance(o, dict):
                 if _SEG_KEY in o and set(o) <= {_SEG_KEY, _NARROW_KEY}:
@@ -372,6 +547,10 @@ def _decode_v2(body: bytes) -> dict:
                     if nw is None:
                         return raw
                     wire_dt, orig_dt = nw
+                    if lazy:
+                        # device-direct decode: defer the widen so device
+                        # consumers can upload the narrow view as-is
+                        return LazySeg(raw, wire_dt, orig_dt)
                     return np.frombuffer(raw, dtype=np.dtype(wire_dt)) \
                         .astype(np.dtype(orig_dt)).tobytes()
                 return {k: fill(v) for k, v in o.items()}
@@ -379,7 +558,10 @@ def _decode_v2(body: bytes) -> dict:
                 return [fill(v) for v in o]
             return o
 
-        return fill(header)
+        t0 = time.perf_counter()
+        out = fill(header)
+        _record_glue("WireDecode", time.perf_counter() - t0)
+        return out
     except (UnicodeDecodeError, ValueError, KeyError,
             IndexError, TypeError) as e:
         raise CorruptFrame(f"undecodable {len(body)}-byte v2 frame: "
@@ -967,7 +1149,10 @@ def local_call(peer: str, mtype: str, fn, *args, **kwargs):
     return out
 
 
-__all__ = ["b64", "unb64", "pack_array", "unpack_array", "send_msg",
+__all__ = ["b64", "unb64", "pack_array", "unpack_array",
+           "unpack_array_device", "device_decode_on",
+           "device_decode_min_bytes", "LazySeg",
+           "widen_pairs", "widen_program", "send_msg",
            "recv_msg", "send_frame", "recv_frame", "encode_frame",
            "decode_frame", "wire_default", "jsonable",
            "NodeServer", "Conn", "ConnPool", "conn_pool", "set_conn_pool",

@@ -63,6 +63,34 @@ class PhaseTimers:
             print(f"    [phase] {name}: +{dt:.3f}s", file=sys.stderr,
                   flush=True)
 
+    def add_split(self, phase: str, kind: str, dt: float) -> None:
+        """Attribute a span to the host_glue/device_compute split of a
+        phase. Stored as "<phase>#<kind>" so the split rides every
+        existing snapshot/CSV surface; split_summary() aggregates it."""
+        self.add(f"{phase}#{kind}", dt)
+
+    def split_summary(self) -> dict:
+        """Aggregate the "<phase>#<kind>" split keys: per-phase seconds by
+        kind plus the headline host_glue_s / device_compute_s /
+        device_share numbers the device-path bench gates on."""
+        with self._lock:
+            items = list(self._acc.items())
+        phases: dict[str, dict] = {}
+        totals = {"host_glue": 0.0, "device_compute": 0.0}
+        for k, v in items:
+            if "#" not in k:
+                continue
+            phase, kind = k.rsplit("#", 1)
+            phases.setdefault(phase, {})[kind] = round(v, 6)
+            if kind in totals:
+                totals[kind] += v
+        denom = totals["host_glue"] + totals["device_compute"]
+        return {"phases": phases,
+                "host_glue_s": round(totals["host_glue"], 6),
+                "device_compute_s": round(totals["device_compute"], 6),
+                "device_share": (round(totals["device_compute"] / denom, 4)
+                                 if denom > 0 else None)}
+
     def span(self, name: str, t0: float, t1: float) -> None:
         """Record an absolute (perf_counter) interval alongside its
         accumulated total. Unlike start/end the caller owns the clock, so

@@ -47,6 +47,10 @@ python scripts/pool_smoke.py > /dev/null
 echo "== net-plane smoke (serial/parallel/v1 survey over one supervised child) =="
 python scripts/bench_net_plane.py --smoke > /dev/null
 
+echo "== device-path smoke (proofs-on survey over one supervised child:"
+echo "== decode on/off x async/serial transcript diff) =="
+python scripts/bench_device_path.py --smoke > /dev/null
+
 echo "== tree-roster smoke (3-level tree vs star over one supervised child:"
 echo "== same sum, fewer bytes at the root) =="
 python scripts/bench_tree_rosters.py --smoke > /dev/null

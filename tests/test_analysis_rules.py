@@ -790,3 +790,79 @@ def test_pallas_dtype_flags_wrong_explicit_dtype():
     found = run_project([("drynx_tpu/crypto/pk.py", src)],
                         "pallas-operand-dtype")
     assert len(found) == 1
+
+
+# -- host-roundtrip-in-decode -----------------------------------------------
+
+ROUNDTRIP_NESTED = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    def decode(d):
+        return jnp.asarray(np.asarray(d["data"]))
+"""
+
+ROUNDTRIP_SEQ = """
+    import numpy as np
+    import jax
+
+    def stage(d, dev):
+        v = np.asarray(d["data"])
+        return jax.device_put(v, dev)
+"""
+
+
+def test_host_roundtrip_fires_on_nested_form_in_service():
+    found = run(ROUNDTRIP_NESTED, relpath=SERVICE,
+                rule="host-roundtrip-in-decode")
+    assert len(found) == 1
+    assert "round-trip" in found[0].message
+
+
+def test_host_roundtrip_fires_on_sequential_form_in_parallel():
+    found = run(ROUNDTRIP_SEQ, relpath=PARALLEL,
+                rule="host-roundtrip-in-decode")
+    assert len(found) == 1
+    assert "'v = np.asarray(...)'" in found[0].message
+
+
+def test_host_roundtrip_silent_outside_scope():
+    # crypto/ and network/ are out of scope: the rule targets the wire /
+    # staging layers this PR made device-direct
+    assert not run(ROUNDTRIP_NESTED, relpath=CRYPTO,
+                   rule="host-roundtrip-in-decode")
+    assert not run(ROUNDTRIP_SEQ, relpath=ELSEWHERE,
+                   rule="host-roundtrip-in-decode")
+
+
+def test_host_roundtrip_silent_on_device_direct_and_host_consumers():
+    src = """
+        import numpy as np
+        import jax.numpy as jnp
+        from drynx_tpu.service.transport import unpack_array_device
+
+        def good_device(d):
+            return unpack_array_device(d)
+
+        def good_host(d):
+            # host consumer: stays numpy, never re-uploads
+            part = np.asarray(d["data"])
+            return part.sum()
+
+        def unrelated(d, x):
+            v = np.asarray(d["data"])
+            # different value uploaded: not a round-trip of v
+            return jnp.asarray(x), v
+    """
+    assert not run(src, relpath=SERVICE, rule="host-roundtrip-in-decode")
+
+
+def test_host_roundtrip_respects_noqa():
+    src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def decode(d):
+            return jnp.asarray(np.asarray(d["data"]))  # drynx: noqa[host-roundtrip-in-decode]
+    """
+    assert not run(src, relpath=SERVICE, rule="host-roundtrip-in-decode")

@@ -47,7 +47,8 @@ def test_registry_names_unique_and_thunks_wellformed(registry):
     for s in registry:
         assert callable(s.lower) and callable(s.dispatched), s.name
         assert s.call is None or callable(s.call), s.name
-        assert s.kind in ("bucketed", "pallas", "fused", "pool"), s.name
+        assert s.kind in ("bucketed", "pallas", "fused", "pool",
+                          "wire"), s.name
 
 
 def test_registry_scales_with_profile():
@@ -242,6 +243,35 @@ def test_registry_pool_program_set():
         assert got == widths, (op, got, widths)
     # pool programs always dispatch (plain device jits, no backend gate)
     assert all(s.dispatched() for s in extra)
+
+
+def test_registry_wire_widen_complete(registry):
+    """Every (narrow, wide) dtype pair the v2 encoder can ship has a
+    registered on-device widen program — a new _NARROW entry in
+    transport without a registry program fails here. Wire programs are
+    profile-independent: present in every registry, always dispatched."""
+    from drynx_tpu.service import transport as T
+
+    wire = [s for s in registry if s.kind == "wire"]
+    names = {s.name for s in wire}
+    for narrow, orig in T.widen_pairs():
+        assert f"wire:widen@{narrow}->{orig}" in names, (narrow, orig)
+    assert len(wire) == len(T.widen_pairs())
+    assert {s.phase for s in wire} == {"WireDecode"}
+    assert all(s.dispatched() for s in wire)
+    # profile-independence: the smallest profile certifies the same set
+    small = cc.build_registry(cc.Profile(n_cns=2, n_dps=2, n_values=2,
+                                         u=4, l=2, dlog_limit=100))
+    assert {s.name for s in small if s.kind == "wire"} == names
+
+
+def test_cli_list_includes_wire_programs(capsys):
+    from drynx_tpu import precompile as cli
+
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "wire:widen@uint16->uint32" in out
+    assert "WireDecode" in out
 
 
 def test_registry_n_noise_zero_is_identity():
