@@ -9,6 +9,15 @@ does not put float64 on the TPU hot path.
 """
 import os
 
+# Opt-in runtime lock-order recorder (analysis/locktrace.py): patch
+# threading.Lock/RLock BEFORE anything in this package creates one, so
+# every named_lock in the tree is traced. The chaos cross-check in
+# tests/test_concurrency_analysis.py runs a real server drain under this
+# and asserts observed acquisition order ⊆ the static lock-order graph.
+if os.environ.get("DRYNX_LOCK_TRACE", "0") == "1":
+    from .analysis import locktrace as _locktrace
+    _locktrace.install()
+
 # Lint-only fast path: the static analyzer (python -m drynx_tpu.analysis)
 # is deliberately jax-free, but importing its parent package triggers
 # ~0.4s of accelerator setup below. DRYNX_SKIP_JAX_INIT=1 skips ALL of it
@@ -71,8 +80,10 @@ if jax is not None and os.environ.get("DRYNX_NO_COMPILE_LOCK", "0") != "1":
 
         from jax._src import compiler as _jax_compiler
 
+        from .resilience.policy import named_lock as _named_lock
+
         _orig_bcl = _jax_compiler.backend_compile_and_load
-        _compile_lock = _threading.Lock()
+        _compile_lock = _named_lock("compile_lock")
         _COMPILE_STACK = 512 * 1024 * 1024
 
         def _locked_backend_compile(*args, **kwargs):

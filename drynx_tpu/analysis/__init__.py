@@ -1,7 +1,9 @@
 """drynx_tpu.analysis — AST-based lint pass enforcing the repo's JAX/crypto
 invariants (jit-global-capture, cross-module-flag-capture, unsafe-pickle,
 implicit-dtype, host-sync-in-hot-path, pallas-operand-dtype,
-env-read-into-trace, secret-logging, hardcoded-timeout, thread-trace).
+env-read-into-trace, secret-logging, hardcoded-timeout, thread-trace,
+unguarded-shared-mutation, lock-order-inversion,
+blocking-call-under-lock).
 
 Per-module rules walk one file; ``[project]`` rules get a
 :class:`ProjectInfo` (import graph + callgraph over the whole package).
@@ -13,12 +15,14 @@ from .core import (REPO_ROOT, RULES, BaselineEntry, Finding, ModuleInfo,
                    load_baseline, module_info_for)
 from .project import ProjectInfo, ProjectRule, analyze_project
 from .dataflow import Dataflow, Secret, dataflow_for
+from .concurrency import Concurrency, concurrency_for
 from .sarif import to_sarif
 from . import rules as _rules  # noqa: F401  (populate the registry)
 from .cli import DEFAULT_BASELINE, main
 
 __all__ = ["REPO_ROOT", "RULES", "BaselineEntry", "Finding", "ModuleInfo",
            "Rule", "ProjectInfo", "ProjectRule", "Dataflow", "Secret",
+           "Concurrency", "concurrency_for",
            "analyze_paths", "analyze_project", "analyze_source",
            "apply_baseline", "dataflow_for", "load_baseline",
            "module_info_for", "to_sarif", "DEFAULT_BASELINE", "main"]

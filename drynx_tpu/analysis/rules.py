@@ -1229,3 +1229,87 @@ class SecretFlowToSink(ProjectRule):
         for raw in df.secret_raw:
             if project.in_focus(raw.file):
                 yield _raw_to_finding(self.id, project, raw)
+
+
+# ---------------------------------------------------------------------------
+# Concurrency rules (drynx_tpu/analysis/concurrency.py): three thin
+# wrappers over one shared engine run — concurrency_for() memoizes on the
+# same content-hash fingerprint as the dataflow engine, so thread-entry
+# discovery, the interprocedural lock-set walk and the lock-order graph
+# are computed once per tree version for all three rules (and for the
+# DRYNX_LOCK_TRACE runtime cross-check).
+
+@register
+class UnguardedSharedMutation(ProjectRule):
+    """A module global, class attribute or shared container is mutated
+    from two concurrent contexts (thread targets, executor submissions,
+    ``fan_out`` worker callables, timers — or one multi-instance entry
+    racing with itself) and the lock sets provably held at the mutation
+    sites share no common lock. That is the textbook data race: lost
+    counter increments, torn dict updates, iteration-during-mutation.
+    The finding names every mutating context and the locks each holds;
+    it is suppressible at the mutation site *or* at the thread entry
+    (dual anchors). Fix by guarding all mutating paths with one named
+    lock (see ``resilience.policy.named_lock``)."""
+
+    id = "unguarded-shared-mutation"
+    summary = ("shared state mutated from multiple thread contexts with "
+               "no common lock held (interprocedural lock-set analysis)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .concurrency import concurrency_for
+        cc = concurrency_for(project)
+        for raw in cc.unguarded_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class LockOrderInversion(ProjectRule):
+    """Two locks are acquired in opposite nesting orders on different
+    code paths — the classic ABBA deadlock: each thread holds one lock
+    and blocks forever waiting for the other. The engine records every
+    nested acquisition (``with`` or bare ``acquire()``) per thread entry,
+    unions the edges into a lock-order graph over the stable diagnostic
+    lock names, and reports each cycle once with the full acquisition
+    chain rendered as a SARIF codeFlow (one threadFlow location per
+    hop). Re-entering an ``RLock`` already held is not an edge. Fix by
+    picking one global order (document it next to the named_lock defs)
+    or collapsing to a single lock."""
+
+    id = "lock-order-inversion"
+    summary = ("named locks acquired in conflicting order on different "
+               "paths — ABBA deadlock cycle in the lock-order graph")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .concurrency import concurrency_for
+        cc = concurrency_for(project)
+        for raw in cc.cycle_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class BlockingCallUnderLock(ProjectRule):
+    """A blocking operation — socket/frame I/O (``recv_msg``,
+    ``send_frame``, ``sendall``...), ``time.sleep``, subprocess spawns,
+    a bare ``join()`` — is reachable while a lock is held. Under load
+    every thread contending on that lock serializes behind the wait:
+    with the proof-device lock or a ConnPool lock this invisibly
+    flattens the serving tier to one in-flight operation. The finding
+    carries the interprocedural path from the thread entry to the call.
+    Fix by moving the wait outside the critical section (snapshot under
+    the lock, operate after release); where the serialization *is* the
+    design — e.g. a per-connection lock serializing one socket
+    conversation — suppress at the site with a reason."""
+
+    id = "blocking-call-under-lock"
+    summary = ("socket/sleep/subprocess/join reachable while holding a "
+               "lock — serializes every contending thread")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .concurrency import concurrency_for
+        cc = concurrency_for(project)
+        for raw in cc.blocking_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)

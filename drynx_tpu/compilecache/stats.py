@@ -12,6 +12,8 @@ from __future__ import annotations
 import sys
 import threading
 
+from ..resilience.policy import named_lock
+
 
 class CompileStats:
     """Thread-safe per-program AOT accounting + persistent-cache counters.
@@ -29,7 +31,7 @@ class CompileStats:
     echo = False
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("compilestats_lock")
         self.rows: dict[str, dict] = {}
         self.persistent_hits = 0
         self.persistent_misses = 0

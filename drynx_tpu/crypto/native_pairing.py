@@ -26,6 +26,8 @@ import threading
 
 import numpy as np
 
+from ..resilience.policy import named_lock
+
 ENABLED = os.environ.get("DRYNX_NATIVE_PAIR", "1") == "1"
 
 _ROOT = os.path.join(os.path.dirname(__file__), "..", "..")
@@ -33,7 +35,7 @@ _SRC = os.path.join(_ROOT, "native", "pairing.cpp")
 _HDR = os.path.join(_ROOT, "native", "pairing_constants.h")
 _LIB_DIR = os.path.join(_ROOT, "native", "build")
 _LIB_PATH = os.path.join(_LIB_DIR, "libdxpairing.so")
-_BUILD_LOCK = threading.Lock()
+_BUILD_LOCK = named_lock("pairing_build_lock")
 _LIB = None
 _LIB_FAILED = False
 

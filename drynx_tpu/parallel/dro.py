@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import elgamal as eg
+from ..resilience.policy import named_lock
 
 # Slab width for chunked precompute / shuffle re-randomization: matches
 # the g1 family's max_bucket (crypto/batching.py) and the bucket-grid
@@ -177,6 +178,9 @@ def slab_widths(size: int, chunk: int | None = None) -> list[int]:
 # (tests/test_pool.py) asserts it stays flat across a simulated restart
 # with a warm pool — the pooled path must never fall through to here.
 PRECOMPUTE_CALLS = 0
+# Scheduler lanes can precompute concurrently; a bare += here would lose
+# increments and flake the restart test's stays-flat assertion.
+_PRECOMPUTE_COUNT_LOCK = named_lock("precompute_count_lock")
 
 
 def _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk: int, phase: str):
@@ -223,7 +227,8 @@ def precompute_rerandomization(key, pub_tbl, size: int, base_tbl=None,
     global PRECOMPUTE_CALLS
 
     _require_table(pub_tbl, "precompute_rerandomization")
-    PRECOMPUTE_CALLS += 1
+    with _PRECOMPUTE_COUNT_LOCK:
+        PRECOMPUTE_CALLS += 1
     base_tbl = base_tbl if base_tbl is not None else eg.BASE_TABLE.table
     r = eg.random_scalars(key, (size,))
     zero_ct = _encrypt_zeros_chunked(r, pub_tbl, base_tbl, chunk,

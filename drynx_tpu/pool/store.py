@@ -51,6 +51,8 @@ import os
 import secrets
 import struct
 import threading
+
+from ..resilience.policy import named_lock
 import zipfile
 
 import numpy as np
@@ -187,7 +189,7 @@ class CryptoPool:
     def __init__(self, root: str, slab_elems: int = 4096):
         self.root = os.path.abspath(root)
         self.slab_elems = int(slab_elems)
-        self._lock = threading.RLock()
+        self._lock = named_lock("ledger_lock", reentrant=True)
         self._consumed: set[str] = set()
         # process-local activity counters (lifetime state is the ledger)
         self.counters = {"deposited": 0, "consumed": 0, "recovered": 0,

@@ -44,6 +44,7 @@ from ..crypto import field as F
 from ..crypto import fp12 as F12
 from ..crypto import g2 as G2
 from ..crypto import pairing as PAIR
+from ..resilience.policy import named_lock
 from ..crypto import params, refimpl
 from ..crypto.field import FN, FP
 from . import encoding as enc
@@ -109,7 +110,8 @@ def sig_gt_table(sigs: list["RangeSig"]) -> jnp.ndarray:
 
     missing = [sg for sg in sigs if sg.gt is None]
     if missing:
-        SIG_BUILD_COUNTS["gt_table"] += 1
+        with _SIG_COUNT_LOCK:
+            SIG_BUILD_COUNTS["gt_table"] += 1
         A_all = jnp.asarray(np.stack([sg.A for sg in missing]), dtype=jnp.uint32)
         qx, qy, _ = B.g2_normalize(A_all)
         bx = jnp.asarray(F.to_mont(jnp.asarray(
@@ -138,6 +140,9 @@ _GT_POW_TABLE_MAX = 4           # ~38 MB each at ns=3, u=16
 # The restart test (tests/test_pool.py) asserts they stay flat when a
 # fresh process reloads from the persistent sig-table store.
 SIG_BUILD_COUNTS = {"gt_table": 0, "pow_table": 0}
+# Verify workers build sig tables concurrently; dict += is read-modify-
+# write, so the counters are bumped under a named lock.
+_SIG_COUNT_LOCK = named_lock("sig_count_lock")
 
 
 def _sig_store():
@@ -178,7 +183,8 @@ def sig_gt_pow_tables(sigs: list["RangeSig"]) -> np.ndarray:
                 _GT_POW_TABLE_CACHE.pop(next(iter(_GT_POW_TABLE_CACHE)))
             return T
 
-    SIG_BUILD_COUNTS["pow_table"] += 1
+    with _SIG_COUNT_LOCK:
+        SIG_BUILD_COUNTS["pow_table"] += 1
     gtA = np.asarray(sig_gt_table(sigs))        # (ns, u, 6, 2, 16)
     ns, u = gtA.shape[0], gtA.shape[1]
     T = np.empty((ns * u, 64, 16, 6, 2, 16), np.uint32)

@@ -43,6 +43,8 @@ from typing import Optional
 
 import numpy as np
 
+from .policy import named_lock
+
 
 def _name_key(name: str) -> int:
     """Stable 64-bit key for a node name (``hash()`` is salted per
@@ -99,7 +101,7 @@ class FaultPlan:
         self._node_verdicts: dict[tuple[int, str], bool] = {}
         self._seq: dict[tuple[int, str], int] = {}       # draw counters
         self._fired_by: dict[tuple[int, str], int] = {}  # per-target caps
-        self._lock = threading.Lock()
+        self._lock = named_lock("faultplan_lock")
         for s in specs:
             self.add(s)
 

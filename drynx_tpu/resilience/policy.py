@@ -23,7 +23,8 @@ from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Optional
+import threading
+from typing import Dict, Optional
 
 # -- named timeout/retry constants (seconds unless suffixed otherwise) ------
 # Connection establishment: cheap, always safe to retry.
@@ -197,7 +198,44 @@ class RetryPolicy:
 
 DEFAULT_POLICY = RetryPolicy()
 
+
+# -- named locks -------------------------------------------------------------
+# Every shared lock in the tree is created through named_lock() so (a) the
+# static concurrency rules (drynx_tpu/analysis/concurrency.py) key their
+# lock-order graph and lock-set findings on a stable diagnostic name —
+# "proof_device_lock", not "service.py line 207" — and (b) the opt-in
+# DRYNX_LOCK_TRACE=1 runtime recorder (drynx_tpu/analysis/locktrace.py)
+# can report observed acquisition order in the same vocabulary, which is
+# what makes the dynamic-subgraph-of-static cross-check possible.
+#
+# LOCK_NAMES maps id(lock) -> name. Identity keys, not weakrefs: named
+# locks in this tree are module- or long-lived-instance state, and the
+# lock-trace recorder needs the name for the whole process lifetime. A
+# name may be registered many times (one per Conn instance, say) — all
+# instances share the diagnostic name, which is exactly the aliasing the
+# static analysis applies.
+
+LOCK_NAMES: Dict[int, str] = {}
+
+
+def named_lock(name: str, *, reentrant: bool = False):
+    """A threading.Lock (or RLock) carrying a stable diagnostic name.
+
+    Calls the *current* ``threading.Lock`` attribute so the
+    DRYNX_LOCK_TRACE patch (installed before any named_lock runs) wraps
+    the instance and the recorder sees its acquisitions by name.
+    """
+    lock = threading.RLock() if reentrant else threading.Lock()
+    LOCK_NAMES[id(lock)] = name
+    return lock
+
+
+def lock_name(lock) -> Optional[str]:
+    """Diagnostic name a lock was registered under, if any."""
+    return LOCK_NAMES.get(id(lock))
+
 __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
+           "named_lock", "lock_name", "LOCK_NAMES",
            "IDEMPOTENT_MTYPES", "CONTRIBUTION_MTYPES",
            "CONNECT_RETRIES", "CONNECT_BACKOFF_S", "BACKOFF_CAP_S",
            "BACKOFF_JITTER", "CALL_TIMEOUT_S", "PING_TIMEOUT_S",

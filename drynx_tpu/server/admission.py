@@ -20,6 +20,7 @@ import dataclasses
 import threading
 
 from .. import compilecache as cc
+from ..resilience.policy import named_lock
 from ..encoding import stats as st
 from ..parallel import proof_plane as plane
 
@@ -84,7 +85,7 @@ class AdmissionController:
         self.n_queue = max(1, n_queue)
         self._warm: set[str] = set()
         self._needed: dict = {}       # Profile -> frozenset of names
-        self._lock = threading.Lock()
+        self._lock = named_lock("admission_lock")
 
     # -- shape derivation --------------------------------------------------
 

@@ -110,10 +110,10 @@ class SyntheticCluster:
         self.vns = types.SimpleNamespace(
             flush_cross_survey=lambda sids: list(sids))
         self.dlog = types.SimpleNamespace(limit=4000)
-        self._proof_device_lock = threading.Lock()
+        self._proof_device_lock = rp.named_lock("proof_device_lock")
         self.executed = 0
         self.finalized = 0
-        self._count_lock = threading.Lock()
+        self._count_lock = rp.named_lock("loadgen_count_lock")
 
     def _ranges_per_value(self, q):
         return list(getattr(q, "ranges", None) or [(4, 2)])
@@ -197,7 +197,7 @@ class LoadGen:
         self.records: list[Record] = []
         self._recs: dict[str, Record] = {}
         self._events: dict[str, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("loadgen_lock")
         self._t0 = 0.0
         server.on_done = self._on_done
 

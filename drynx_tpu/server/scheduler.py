@@ -159,8 +159,8 @@ class SurveyServer:
         self._results: dict[str, object] = {}
         self._errors: dict[str, Exception] = {}
         self._admissions: dict[str, adm.Admission] = {}
-        self._lock = threading.Lock()
-        self._results_lock = threading.Lock()
+        self._lock = rp.named_lock("scheduler_lock")
+        self._results_lock = rp.named_lock("scheduler_results_lock")
         # completion clock: drives the Overloaded retry-after hint and
         # the refill lane's demand forecast
         self._done_t: collections.deque = collections.deque(

@@ -291,7 +291,7 @@ class DrynxNode:
         # bytes instead of re-encrypting, so a contribution can never be
         # double-counted and its range proof never double-fires.
         self._dp_replies: dict[str, dict] = {}
-        self._state_lock = threading.Lock()  # handlers run on server threads
+        self._state_lock = rp.named_lock("node_state_lock")  # handlers run on server threads
 
         s = self.server
         s.register("set_roster", self._h_set_roster)

@@ -54,7 +54,7 @@ class SurveyProofState:
 # concurrently means CONCURRENT XLA compiles, which segfault the CPU
 # compiler under load (see pytest.ini). Verification throughput comes from
 # batching inside one call, not from thread overlap.
-_VERIFY_DEVICE_LOCK = threading.Lock()
+_VERIFY_DEVICE_LOCK = rp.named_lock("verify_device_lock")
 
 
 class VerifyCache:
@@ -84,7 +84,7 @@ class VerifyCache:
 
     def __init__(self, maxsize: int = 256):
         self._d: dict = {}
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("verify_cache_lock")
         self.maxsize = maxsize
         self.hits = 0
         self.misses = 0
@@ -125,7 +125,7 @@ class _LockedRng:
 
     def __init__(self, rng: np.random.Generator):
         self._rng = rng
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("locked_rng_lock")
 
     def random(self) -> float:
         with self._lock:
@@ -152,7 +152,7 @@ class VerifyingNode:
         self.local_bitmaps: dict[str, dict[str, int]] = {}
         self.chain = SkipChain(self.db,
                                [bitmap_verifier(self.local_bitmaps)])
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("verifying_node_lock")
 
     # -- reference HandleSurveyQueryToVN (service_skipchain.go:31-93)
     def register_survey(self, survey_id: str, expected_proofs: int,

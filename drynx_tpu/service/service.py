@@ -204,7 +204,7 @@ class LocalCluster:
         self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
         self.surveys: dict[str, Survey] = {}
         # serializes proof threads' device work (see _async_proof)
-        self._proof_device_lock = threading.Lock()
+        self._proof_device_lock = rp.named_lock("proof_device_lock")
         self._aot_mode = precompile
         self._aot_warmed = False
         # recursion-limit + thread-stack-size guard BEFORE any proof

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..resilience.policy import named_lock
 from .store import ProofDB
 
 
@@ -78,7 +79,7 @@ class SkipChain:
         # append is a read-modify-write on _length: with a verify-worker
         # POOL (server/scheduler.py) two surveys' end_verification commits
         # can race here, so the chain extension is serialized
-        self._append_lock = threading.Lock()
+        self._append_lock = named_lock("skipchain_append_lock")
 
     # -- reference API surface: CreateProofSkipchain / AppendProofSkipchain
     def create_genesis(self, data: DataBlock) -> Block:

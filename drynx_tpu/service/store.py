@@ -12,12 +12,14 @@ import ctypes
 import os
 import threading
 
+from ..resilience.policy import named_lock
+
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                     "proofdb.cpp")
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                         "build")
 _LIB_PATH = os.path.join(_LIB_DIR, "libproofdb.so")
-_BUILD_LOCK = threading.Lock()
+_BUILD_LOCK = named_lock("proofdb_build_lock")
 _LIB = None
 _LIB_FAILED = False
 
@@ -64,7 +66,7 @@ class ProofDB:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = named_lock("proofdb_lock")
         lib = _load_lib()
         if lib is not None:
             self._h = lib.pdb_open(path.encode())

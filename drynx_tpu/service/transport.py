@@ -129,7 +129,7 @@ class LinkModel:
         self.delay_s = float(delay_ms) / 1e3
         self.byte_s = (8.0 / (float(bandwidth_mbps) * 1e6)
                        if bandwidth_mbps else 0.0)
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("linkmodel_lock")
         self.bytes_total = 0
         self.msgs_total = 0
         self.by_peer: dict[str, int] = {}
@@ -794,7 +794,7 @@ class Conn:
         self.sent = False
         self.wire = 1
         self._timeout = float(timeout)
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("conn_lock")
         plan = faults.fault_plan()
         if plan is not None:
             if plan.killed(self.peer):
@@ -832,7 +832,13 @@ class Conn:
                     f"connection closed by {self.peer} during wire "
                     f"negotiation")
 
-    def call(self, obj: dict) -> dict:
+    # One request/response per connection AT A TIME is the wire contract:
+    # the per-connection lock below deliberately covers send_frame +
+    # recv_frame (a second thread interleaving frames on the same socket
+    # would corrupt both conversations). Cross-peer parallelism comes
+    # from the pool handing out one Conn per worker, never from sharing
+    # a socket.
+    def call(self, obj: dict) -> dict:  # drynx: noqa[blocking-call-under-lock]
         mtype = obj.get("type", "")
         if self.broken or self.closed:
             raise ConnectionClosed(
@@ -928,7 +934,7 @@ class ConnPool:
             env = os.environ.get("DRYNX_CONN_POOL_MAX", "").strip()
             max_total = int(env) if env else rp.CONN_POOL_MAX
         self.max_total = int(max_total)
-        self._lock = threading.Lock()
+        self._lock = rp.named_lock("connpool_lock")
         # stacks hold (stamp, Conn); LIFO per key keeps the warmest
         # socket on top, the monotonic stamp orders LRU eviction globally
         self._idle: dict[tuple, list[tuple[int, Conn]]] = {}
@@ -1061,6 +1067,10 @@ class ConnPool:
 
 
 _POOL: Optional[ConnPool] = None
+# Guards lazy creation/replacement of the process pool: two fan_out
+# workers racing through conn_pool() must never build two pools (the
+# loser's pool — and every socket it ever opens — would leak unpooled).
+_POOL_LOCK = rp.named_lock("connpool_init_lock")
 
 
 def pool_enabled() -> bool:
@@ -1074,15 +1084,18 @@ def conn_pool() -> Optional[ConnPool]:
     if not pool_enabled():
         return None
     if _POOL is None:
-        _POOL = ConnPool()
+        with _POOL_LOCK:
+            if _POOL is None:
+                _POOL = ConnPool()
     return _POOL
 
 
 def set_conn_pool(p: Optional[ConnPool]) -> None:
     global _POOL
-    if _POOL is not None and _POOL is not p:
-        _POOL.close_all()
-    _POOL = p
+    with _POOL_LOCK:
+        old, _POOL = _POOL, p
+    if old is not None and old is not p:
+        old.close_all()
 
 
 def local_call(peer: str, mtype: str, fn, *args, **kwargs):

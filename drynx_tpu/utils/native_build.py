@@ -42,8 +42,11 @@ def build_native_lib(srcs: list[str], lib_path: str,
     os.makedirs(os.path.dirname(lib_path), exist_ok=True)
     tmp = f"{lib_path}.tmp.{os.getpid()}"
     try:
-        subprocess.run(["g++", *flags, srcs[0], "-o", tmp],
-                       check=True, capture_output=True, text=True)
+        # compile-once-others-wait IS the point of the build lock the
+        # callers hold
+        subprocess.run(  # drynx: noqa[blocking-call-under-lock]
+            ["g++", *flags, srcs[0], "-o", tmp],
+            check=True, capture_output=True, text=True)
         os.replace(tmp, lib_path)
         with open(stamp + f".tmp.{os.getpid()}", "w") as f:
             f.write(digest)

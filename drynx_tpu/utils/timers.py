@@ -14,6 +14,8 @@ import io
 import threading
 import time
 
+from ..resilience.policy import named_lock
+
 
 class PhaseTimers:
     """Thread-safe named wall-clock timers accumulating per-phase seconds.
@@ -25,7 +27,7 @@ class PhaseTimers:
     echo = False
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("timers_lock")
         self._open: dict[str, float] = {}
         self._acc: dict[str, float] = {}
         self._spans: list[tuple[str, float, float]] = []

@@ -19,6 +19,11 @@ DRYNX_SKIP_JAX_INIT=1 python -m drynx_tpu.analysis tests/fixtures/lintpkg \
 echo "== dataflow + sarif unit tests =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly tests/test_dataflow.py
 
+echo "== concurrency tier (engine unit tests + fixture goldens; the"
+echo "== DRYNX_LOCK_TRACE dynamic cross-check runs in the chaos tier) =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not chaos' \
+    tests/test_concurrency_analysis.py
+
 echo "== precompile registry smoke (trace+lower the proofs-on program set) =="
 JAX_PLATFORMS=cpu python -m drynx_tpu.precompile --dry-run --quiet
 
@@ -34,9 +39,11 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     tests/test_service_vn.py \
     tests/test_datasets_timedata.py
 
-echo "== chaos quick tier (seeded fault injection, -m 'chaos and not slow') =="
+echo "== chaos quick tier (seeded fault injection, -m 'chaos and not slow';"
+echo "== + the DRYNX_LOCK_TRACE dynamic/static lock-order cross-check) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
-    -m 'chaos and not slow' tests/test_resilience.py
+    -m 'chaos and not slow' tests/test_resilience.py \
+    tests/test_concurrency_analysis.py
 
 echo "== scale smoke (tiny grid points, one supervised child per point) =="
 python scripts/bench_scale_axes.py --cpu --smoke > /dev/null

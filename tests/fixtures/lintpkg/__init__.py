@@ -1,11 +1,14 @@
 """lintpkg — deliberately-broken fixture package for the project-level
 lint pass (never imported at runtime; the analyzer only parses it).
 
-It contains exactly five violations, one per project rule: a
-cross-module env-flag capture, a 2-hop host sync reachable from a jit
-entry, a weak-dtype pallas operand, a pytree dtype-laundering round trip
+It contains one violation per project rule: a cross-module env-flag
+capture, a 2-hop host sync reachable from a jit entry, a weak-dtype
+pallas operand, a pytree dtype-laundering round trip
 (ciphertext-dtype-launder) and a nonce flowing into a log call
 (secret-flow-to-sink, which absorbs the regex secret-logging hit on the
-same line). tests/test_static_analysis.py asserts the CLI reports
-exactly these, each with a rendered call/value chain.
+same line). concurrency.py adds the four concurrency violations: two
+unguarded-shared-mutation sites, a 2-lock order inversion, and a
+blocking sleep under both locks. tests/test_static_analysis.py asserts
+the CLI reports exactly these eleven, each with a rendered
+call/value chain.
 """

@@ -1,0 +1,55 @@
+"""Concurrency fixture: two thread entries sharing module state.
+
+Exactly four concurrency violations, exercising every project rule from
+drynx_tpu/analysis/concurrency.py:
+
+* ``UNGUARDED`` is bumped by both ``drain`` and ``verify`` with no lock
+  held — two ``unguarded-shared-mutation`` findings (one per site).
+* ``drain`` nests fixture_lock_a -> fixture_lock_b while ``verify``
+  nests them the other way — one ``lock-order-inversion`` cycle.
+* ``verify`` sleeps while holding both locks — one
+  ``blocking-call-under-lock``.
+
+``GUARDED`` is the negative control: every mutation happens under
+``_G_LOCK`` (an *anonymous* ``threading.Lock``, covering positional lock
+identity), so it must NOT be reported.
+"""
+import threading
+import time
+
+from drynx_tpu.resilience.policy import named_lock
+
+GUARDED = 0
+UNGUARDED = 0
+
+_G_LOCK = threading.Lock()
+_LOCK_A = named_lock("fixture_lock_a")
+_LOCK_B = named_lock("fixture_lock_b")
+
+
+def drain() -> None:
+    global GUARDED, UNGUARDED
+    with _G_LOCK:
+        GUARDED += 1
+    UNGUARDED += 1
+    with _LOCK_A:
+        with _LOCK_B:
+            pass
+
+
+def verify() -> None:
+    global GUARDED, UNGUARDED
+    with _G_LOCK:
+        GUARDED += 1
+    UNGUARDED += 1
+    with _LOCK_B:
+        with _LOCK_A:
+            time.sleep(0.01)  # drynx: noqa[hardcoded-timeout]
+
+
+def start():
+    t1 = threading.Thread(target=drain, daemon=True)
+    t2 = threading.Thread(target=verify, daemon=True)
+    t1.start()
+    t2.start()
+    return t1, t2

@@ -1,0 +1,582 @@
+"""Concurrency analysis pass: unit tests for the engine, goldens for
+the fixture package, and the dynamic/static cross-check.
+
+Engine unit tests build tiny synthetic projects with
+ProjectInfo.from_sources (same idiom as test_dataflow.py) and inspect
+the Concurrency facts directly. The chaos-marker test at the bottom is
+the soundness proof for the lock-order graph: it drains a real 2-worker
+SurveyServer in a child process under DRYNX_LOCK_TRACE=1 and asserts
+every dynamically observed acquisition-order edge between named locks is
+present in the static graph — the analysis must over-approximate the
+runtime, or its cycle verdicts mean nothing.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from drynx_tpu.analysis import RULES, ProjectInfo
+from drynx_tpu.analysis.concurrency import Concurrency, concurrency_for
+from drynx_tpu.analysis.core import suppressed_at
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "drynx_tpu"
+FIXTURE = REPO_ROOT / "tests" / "fixtures" / "lintpkg"
+GOLDEN_CC = REPO_ROOT / "tests" / "fixtures" / "lintpkg_concurrency.json"
+GOLDEN_FLOW = REPO_ROOT / "tests" / "fixtures" / "lintpkg_cycle_codeflow.json"
+
+CC_RULES = {"unguarded-shared-mutation", "lock-order-inversion",
+            "blocking-call-under-lock"}
+
+
+def cc_of(pairs):
+    project = ProjectInfo.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in pairs])
+    return Concurrency(project).run()
+
+
+def findings_of(pairs):
+    """The three concurrency project rules over a synthetic project,
+    with noqa suppression applied — the analyze_project slice that
+    matters here, without re-reading the tree from disk."""
+    project = ProjectInfo.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in pairs])
+    findings = []
+    for rid in sorted(CC_RULES):
+        findings.extend(RULES[rid].run_project(project))
+    findings = [f for f in findings
+                if not suppressed_at(f, project.modules)]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# -- thread-entry discovery --------------------------------------------------
+
+def test_thread_target_and_timer_entries_are_discovered():
+    cc = cc_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        def worker():
+            pass
+
+        def tick():
+            pass
+
+        def start():
+            threading.Thread(target=worker, daemon=True).start()
+            threading.Timer(1.0, tick).start()
+    """)])
+    kinds = {fid.split(":")[-1]: e.kind for fid, e in cc.entries.items()}
+    assert kinds["worker"] == "thread-target"
+    assert kinds["tick"] == "timer"
+    assert not cc.entries[
+        next(f for f in cc.entries if f.endswith("worker"))].multi
+
+
+def test_spawn_in_loop_and_executor_submit_are_multi_instance():
+    cc = cc_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        def worker():
+            pass
+
+        def job(x):
+            return x
+
+        def start(pool):
+            for _ in range(4):
+                threading.Thread(target=worker).start()
+            pool.submit(job, 1)
+    """)])
+    by_leaf = {fid.split(":")[-1]: e for fid, e in cc.entries.items()}
+    assert by_leaf["worker"].multi          # spawned in a loop
+    assert by_leaf["job"].kind == "executor"
+    assert by_leaf["job"].multi             # pools are many-threaded
+
+
+def test_wrapper_factory_target_resolves_to_the_nested_worker():
+    cc = cc_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        def make_worker(cfg):
+            def run():
+                return cfg
+            return run
+
+        def start():
+            threading.Thread(target=make_worker({})).start()
+    """)])
+    assert any(fid.endswith("make_worker.run") for fid in cc.entries), \
+        sorted(cc.entries)
+
+
+def test_method_reference_target_resolves():
+    cc = cc_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        class Server:
+            def loop(self):
+                pass
+
+            def start(self):
+                threading.Thread(target=self.loop).start()
+    """)])
+    assert any(fid.endswith("Server.loop") for fid in cc.entries)
+
+
+def test_fan_out_call_argument_is_a_pool_entry():
+    cc = cc_of([
+        ("drynx_tpu/parallel/net_plane.py", """\
+            def fan_out(entries, make_msg, call=None):
+                pass
+        """),
+        ("drynx_tpu/svc.py", """\
+            from .parallel.net_plane import fan_out
+
+            def send_one(ent):
+                pass
+
+            def broadcast(entries):
+                fan_out(entries, dict, call=send_one)
+        """),
+    ])
+    by_leaf = {fid.split(":")[-1]: e for fid, e in cc.entries.items()}
+    assert by_leaf["send_one"].kind == "fan-out"
+    assert by_leaf["send_one"].multi
+
+
+# -- unguarded shared mutation ----------------------------------------------
+
+TWO_WORKERS_HEADER = """\
+    import threading
+
+    COUNT = 0
+    _LOCK = threading.Lock()
+
+    def start():
+        threading.Thread(target=a).start()
+        threading.Thread(target=b).start()
+"""
+
+
+def test_same_lock_in_both_threads_is_clean():
+    assert findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+
+        def a():
+            global COUNT
+            with _LOCK:
+                COUNT += 1
+
+        def b():
+            global COUNT
+            with _LOCK:
+                COUNT += 1
+    """)]) == []
+
+
+def test_disjoint_locksets_are_flagged():
+    findings = findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+        _OTHER = threading.Lock()
+
+        def a():
+            global COUNT
+            with _LOCK:
+                COUNT += 1
+
+        def b():
+            global COUNT
+            with _OTHER:
+                COUNT += 1
+    """)])
+    assert {f.rule for f in findings} == {"unguarded-shared-mutation"}
+    assert len(findings) == 2               # both sites, no common lock
+
+
+def test_single_thread_context_is_not_a_race():
+    # one entry, even mutating bare: no second concurrent context
+    assert findings_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        COUNT = 0
+
+        def a():
+            global COUNT
+            COUNT += 1
+
+        def start():
+            threading.Thread(target=a).start()
+    """)]) == []
+
+
+def test_multi_instance_entry_races_with_itself():
+    findings = findings_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        COUNT = 0
+
+        def a():
+            global COUNT
+            COUNT += 1
+
+        def start():
+            for _ in range(2):
+                threading.Thread(target=a).start()
+    """)])
+    assert [f.rule for f in findings] == ["unguarded-shared-mutation"]
+
+
+def test_lockset_is_intersected_across_if_branches():
+    # lock held in only ONE branch of an if: the join must drop it,
+    # so the mutation after the if counts as unguarded
+    findings = findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+
+        def a():
+            global COUNT
+            with _LOCK:
+                COUNT += 1
+
+        def b(flag):
+            global COUNT
+            if flag:
+                _LOCK.acquire()
+            COUNT += 1
+    """)])
+    lines = sorted(f.line for f in findings
+                   if f.rule == "unguarded-shared-mutation")
+    assert len(lines) == 2                  # b's site AND a's (disjoint)
+
+
+def test_bare_acquire_release_tracks_the_held_set():
+    assert findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+
+        def a():
+            global COUNT
+            _LOCK.acquire()
+            COUNT += 1
+            _LOCK.release()
+
+        def b():
+            global COUNT
+            with _LOCK:
+                COUNT += 1
+    """)]) == []
+
+
+def test_try_finally_release_keeps_the_body_guarded():
+    assert findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+
+        def a():
+            global COUNT
+            _LOCK.acquire()
+            try:
+                COUNT += 1
+            finally:
+                _LOCK.release()
+
+        def b():
+            global COUNT
+            with _LOCK:
+                COUNT += 1
+    """)]) == []
+
+
+def test_guard_is_recognized_interprocedurally():
+    # the lock is taken in the entry; the mutation happens two calls down
+    assert findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+
+        def bump():
+            global COUNT
+            COUNT += 1
+
+        def locked_bump():
+            with _LOCK:
+                bump()
+
+        def a():
+            locked_bump()
+
+        def b():
+            locked_bump()
+    """)]) == []
+
+
+# -- lock-order inversion ----------------------------------------------------
+
+INVERSION = """\
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def fwd():
+        with A:
+            with B:
+                pass
+
+    def rev():
+        with B:
+            with A:
+                pass
+
+    def start():
+        threading.Thread(target=fwd).start()
+        threading.Thread(target=rev).start()
+"""
+
+
+def test_ab_ba_nesting_is_a_cycle():
+    findings = findings_of([("drynx_tpu/svc.py", INVERSION)])
+    cycles = [f for f in findings if f.rule == "lock-order-inversion"]
+    assert len(cycles) == 1
+    # the chain is a full witness: both acquisition orders, renderable
+    # as a SARIF codeFlow
+    assert len(cycles[0].call_chain) >= 4
+
+
+def test_consistent_order_is_clean():
+    src = INVERSION.replace("with B:\n            with A:",
+                            "with A:\n            with B:")
+    assert src != INVERSION
+    findings = findings_of([("drynx_tpu/svc.py", src)])
+    assert [f for f in findings if f.rule == "lock-order-inversion"] == []
+
+
+def test_rlock_reentry_is_not_a_self_cycle():
+    findings = findings_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        L = threading.RLock()
+
+        def inner():
+            with L:
+                pass
+
+        def outer():
+            with L:
+                inner()
+
+        def start():
+            threading.Thread(target=outer).start()
+            threading.Thread(target=inner).start()
+    """)])
+    assert [f for f in findings if f.rule == "lock-order-inversion"] == []
+
+
+# -- blocking call under lock ------------------------------------------------
+
+def test_sleep_under_lock_is_flagged_and_bare_sleep_is_not():
+    findings = findings_of([("drynx_tpu/svc.py", """\
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def worker():
+            time.sleep(1)
+            with L:
+                time.sleep(1)
+
+        def start():
+            threading.Thread(target=worker).start()
+    """)])
+    blocked = [f for f in findings if f.rule == "blocking-call-under-lock"]
+    assert len(blocked) == 1
+    assert "sleep" in blocked[0].message
+
+
+def test_join_with_separator_args_is_not_blocking():
+    findings = findings_of([("drynx_tpu/svc.py", """\
+        import threading
+
+        L = threading.Lock()
+
+        def worker(parts, t):
+            with L:
+                x = ",".join(parts)      # str.join: not blocking
+                t.join()                 # thread join: blocking
+            return x
+
+        def start(t):
+            threading.Thread(target=worker, args=([], t)).start()
+    """)])
+    blocked = [f for f in findings if f.rule == "blocking-call-under-lock"]
+    assert len(blocked) == 1
+    assert blocked[0].message.count("join") >= 1
+
+
+# -- suppression (dual anchors) ---------------------------------------------
+
+def test_noqa_on_the_mutation_site_suppresses():
+    findings = findings_of([("drynx_tpu/svc.py", TWO_WORKERS_HEADER + """\
+
+        def a():
+            global COUNT
+            COUNT += 1  # drynx: noqa[unguarded-shared-mutation]
+
+        def b():
+            global COUNT
+            COUNT += 1  # drynx: noqa[unguarded-shared-mutation]
+    """)])
+    assert findings == []
+
+
+def test_noqa_on_the_spawn_anchor_suppresses_the_whole_chain():
+    # the second anchor of an unguarded finding is the chain head — the
+    # entry's spawn site — so one noqa there covers the finding even
+    # though the mutation line itself is clean
+    dirty = [("drynx_tpu/svc.py", """\
+        import threading
+
+        COUNT = 0
+
+        def a():
+            global COUNT
+            COUNT += 1
+
+        def start():
+            for _ in range(2):
+                threading.Thread(target=a).start()
+    """)]
+    assert len(findings_of(dirty)) == 1
+    anchored = [(dirty[0][0], dirty[0][1].replace(
+        "threading.Thread(target=a).start()",
+        "threading.Thread(target=a).start()"
+        "  # drynx: noqa[unguarded-shared-mutation]"))]
+    assert findings_of(anchored) == []
+
+
+# -- fixture goldens ---------------------------------------------------------
+
+def _cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def test_fixture_concurrency_findings_match_golden():
+    proc = _cli([str(FIXTURE), "--no-baseline", "--format", "json"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    got = [f for f in json.loads(proc.stdout)["findings"]
+           if f["rule"] in CC_RULES]
+    golden = json.loads(GOLDEN_CC.read_text(encoding="utf-8"))
+    assert got == golden
+
+
+def test_fixture_cycle_renders_a_sarif_codeflow():
+    proc = _cli([str(FIXTURE), "--no-baseline", "--format", "sarif"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    results = json.loads(proc.stdout)["runs"][0]["results"]
+    cycles = [r for r in results if r["ruleId"] == "lock-order-inversion"]
+    assert len(cycles) == 1
+    golden = json.loads(GOLDEN_FLOW.read_text(encoding="utf-8"))
+    assert cycles[0]["codeFlows"] == golden
+
+
+# -- the real tree -----------------------------------------------------------
+
+def test_real_tree_is_clean_and_fast():
+    # fresh interpreter, the way check.sh runs it; the <5s budget is the
+    # acceptance bar for the WHOLE project pass including concurrency
+    prog = (
+        "import json, sys, time\n"
+        "from drynx_tpu.analysis.project import analyze_project\n"
+        "from drynx_tpu.analysis import ProjectInfo\n"
+        "from drynx_tpu.analysis.concurrency import concurrency_for\n"
+        "t0 = time.monotonic()\n"
+        "findings = analyze_project([%r])\n"
+        "elapsed = time.monotonic() - t0\n"
+        "project, _ = ProjectInfo.from_paths([%r])\n"
+        "cc = concurrency_for(project)\n"
+        "json.dump({'elapsed': elapsed,\n"
+        "           'findings': [f.render() for f in findings],\n"
+        "           'entries': len(cc.entries),\n"
+        "           'locks': len(cc.lock_defs),\n"
+        "           'edges': sorted(cc.named_lock_edges())}, sys.stdout)\n"
+        % (str(PACKAGE), str(PACKAGE)))
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run([sys.executable, "-c", prog], cwd=str(REPO_ROOT),
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["findings"] == [], "\n".join(out["findings"])
+    assert out["elapsed"] < 5.0, \
+        f"project pass took {out['elapsed']:.1f}s (budget 5s)"
+    # the pass actually sees the tree: the service layer spawns threads
+    # and takes named locks all over
+    assert out["entries"] >= 10, out
+    assert out["locks"] >= 15, out
+
+
+# -- dynamic cross-check -----------------------------------------------------
+
+_TRACE_CHILD = """\
+import json, sys
+from drynx_tpu.analysis import locktrace
+assert locktrace.installed(), "DRYNX_LOCK_TRACE=1 did not install"
+
+import numpy as np
+from drynx_tpu.server import Overloaded, QueueFull, SurveyServer
+from drynx_tpu.service.service import LocalCluster
+
+cl = LocalCluster(n_cns=1, n_dps=2, n_vns=0, seed=23, dlog_limit=1000)
+for i, dp in enumerate(cl.dps.values()):
+    dp.data = np.arange(4, dtype=np.int64) + i
+# small queue + aggressive shedding: a burst of submits drives the
+# scheduler through the Overloaded path, whose retry_after hint reads
+# the completion clock (results lock) while the intake lock is held —
+# the one named-lock nesting in the tree, exhibited for real
+srv = SurveyServer(cl, pipeline=True, workers=2, max_batch=1,
+                   max_depth=4, tenant_quota=8, shed_fraction=0.5)
+shed = done = 0
+for i in range(8):
+    try:
+        srv.submit(cl.generate_survey_query(
+            "sum", query_min=0, query_max=9, proofs=0,
+            survey_id="trace%d" % i))
+        done += 1
+    except (Overloaded, QueueFull):
+        shed += 1
+results = srv.drain()
+assert len(results) == done, (len(results), done)
+
+json.dump({"edges": sorted(locktrace.observed_edges()),
+           "acquires": locktrace.acquisition_count(),
+           "shed": shed, "completed": done}, sys.stdout)
+"""
+
+
+@pytest.mark.chaos
+def test_observed_lock_order_is_a_subgraph_of_the_static_graph():
+    """Soundness: every acquisition-order edge a REAL multi-worker server
+    drain exhibits between named locks must already be in the static
+    lock-order graph. A dynamic edge the analysis missed would mean its
+    cycle verdicts are unsound."""
+    env = dict(os.environ, DRYNX_LOCK_TRACE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", _TRACE_CHILD],
+                          cwd=str(REPO_ROOT), capture_output=True,
+                          text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    # non-vacuity: a recorder that saw nothing proves nothing — the
+    # burst must actually shed (that's the path that nests two named
+    # locks) and the drain must actually run
+    assert out["acquires"] > 0
+    assert out["shed"] > 0, out
+    assert out["completed"] > 0, out
+    observed = {tuple(e) for e in out["edges"]}
+    assert observed, "shed path exhibited no named-lock nesting"
+
+    project, errors = ProjectInfo.from_paths([PACKAGE])
+    assert errors == []
+    static = concurrency_for(project).named_lock_edges()
+    missing = observed - static
+    assert not missing, (
+        f"dynamic edges missing from the static lock-order graph "
+        f"(analysis is UNSOUND for these): {sorted(missing)}\n"
+        f"static graph: {sorted(static)}")

@@ -32,7 +32,9 @@ def test_registry_has_the_documented_rules():
                 "unsafe-pickle", "implicit-dtype", "host-sync-in-hot-path",
                 "pallas-operand-dtype", "env-read-into-trace",
                 "secret-logging", "hardcoded-timeout", "thread-trace",
-                "ciphertext-dtype-launder", "secret-flow-to-sink"}
+                "ciphertext-dtype-launder", "secret-flow-to-sink",
+                "unguarded-shared-mutation", "lock-order-inversion",
+                "blocking-call-under-lock"}
     assert expected <= set(RULES), sorted(expected - set(RULES))
 
 
@@ -125,17 +127,20 @@ def test_list_rules_marks_project_rules():
     assert "unsafe-pickle:" in proc.stdout  # per-module rules unmarked
 
 
-def test_fixture_package_yields_exactly_the_seven_findings():
+def test_fixture_package_yields_exactly_the_eleven_findings():
     proc = _cli([str(FIXTURE), "--no-baseline"])
     assert proc.returncode == 1, proc.stdout + proc.stderr
     out = proc.stdout
     for rule in ("cross-module-flag-capture", "host-sync-in-hot-path",
-                 "pallas-operand-dtype", "ciphertext-dtype-launder"):
+                 "pallas-operand-dtype", "ciphertext-dtype-launder",
+                 "lock-order-inversion", "blocking-call-under-lock"):
         assert out.count(f"[{rule}]") == 1, out
     # announce + annotated_leak (annotation seed) + batch_leak (container
     # mutation) — see the fixture docstring
     assert out.count("[secret-flow-to-sink]") == 3, out
-    assert out.count("call chain:") == 7, out
+    # UNGUARDED is bumped bare from both thread entries: one per site
+    assert out.count("[unguarded-shared-mutation]") == 2, out
+    assert out.count("call chain:") == 11, out
 
 
 def test_json_format_has_stable_call_chain_field():
@@ -143,7 +148,7 @@ def test_json_format_has_stable_call_chain_field():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     findings = data["findings"]
-    assert len(findings) == 7
+    assert len(findings) == 11
     for f in findings:
         assert isinstance(f["call_chain"], list) and f["call_chain"]
         assert all(isinstance(h, str) for h in f["call_chain"])
