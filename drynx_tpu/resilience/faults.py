@@ -11,7 +11,8 @@ points — the same module-global pattern as its ``LinkModel``:
   where="reply"    server side, around sending the reply   (same kinds —
                                                             "the peer died
                                                             mid-answer")
-  where="node"     server side, the whole node             (kill, pause)
+  where="node"     server side, the whole node             (kill, pause,
+                                                            partition)
 
 Determinism: every draw is keyed, not streamed. A link-level event draws
 from ``np.random.default_rng((seed, spec_idx, name_key(target), seq))``
@@ -23,11 +24,23 @@ RPCs across worker threads nondeterministically; per-node keying keeps
 the 17 chaos scenarios and the kill-DP soak seed-reproducible anyway.
 ``count`` caps are per-(spec, target) for the same reason (a global cap
 would be consumed by whichever thread arrived first); ``spec.fired``
-remains the total across targets. Node-level verdicts are keyed per
-(spec, node) and memoized so "is dp3 dead?" never flips mid-run. Two runs
-with the same plan seed take identical per-node fault decisions whatever
-the traffic interleaving (asserted in tests/test_resilience.py and
-tests/test_net_plane.py).
+remains the total across targets.
+
+Node-level verdicts are *fault episodes*: the seeded membership draw is
+keyed per (spec, node) and memoized (so whether dp3 is in the blast
+radius never depends on traffic order), and the spec's time window
+``[after_s, after_s + heal_after_s)`` decides when the episode is live.
+A spec with ``heal_after_s=None`` is the legacy permanent fault — the
+node is dead or alive for the whole run, never flapping. With a window,
+the node goes down at ``after_s`` on the plan's clock and heals at
+``after_s + heal_after_s``; two plans with the same seed and specs see
+identical down/up timelines (the clock only gates *when*, membership and
+ordering come from the seed). The ``partition`` kind cuts the links
+between two fnmatch'd node sets (``target`` × ``peer``) both ways for
+the window; each (spec, unordered pair) membership is its own seeded
+draw. Two runs with the same plan seed take identical per-node fault
+decisions whatever the traffic interleaving (asserted in
+tests/test_resilience.py and tests/test_net_plane.py).
 
 No transport import here (transport imports *us*); no jax import either —
 like the analysis package, chaos tooling must work when the accelerator
@@ -39,7 +52,8 @@ import dataclasses
 import fnmatch
 import hashlib
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -53,8 +67,9 @@ def _name_key(name: str) -> int:
         hashlib.blake2s(name.encode(), digest_size=8).digest(), "big")
 
 KINDS = ("refuse", "drop", "delay", "close_mid_frame", "corrupt",
-         "kill", "pause")
+         "kill", "pause", "partition")
 WHERES = ("connect", "request", "reply", "node")
+NODE_KINDS = ("kill", "pause", "partition")
 
 
 @dataclasses.dataclass
@@ -63,7 +78,13 @@ class FaultSpec:
     ("dp3", "dp*", "*"); ``mtype`` filters by message type for
     request/reply hooks ("*" = any). ``prob`` gates each firing through
     the spec's seeded stream; ``count`` caps total firings (None =
-    unlimited). ``delay_s`` parameterizes delay/pause."""
+    unlimited). ``delay_s`` parameterizes delay/pause.
+
+    Node-level specs (kill/pause/partition) are *episodes*: live during
+    ``[after_s, after_s + heal_after_s)`` on the plan clock;
+    ``heal_after_s=None`` means permanent (the legacy never-flap
+    semantics). ``peer`` is the second fnmatch set for ``partition`` —
+    the cut severs every target×peer link, both directions."""
 
     where: str
     kind: str
@@ -72,6 +93,9 @@ class FaultSpec:
     prob: float = 1.0
     count: Optional[int] = None
     delay_s: float = 0.0
+    after_s: float = 0.0
+    heal_after_s: Optional[float] = None
+    peer: str = "*"
     fired: int = 0     # mutated under the plan lock
 
     def __post_init__(self):
@@ -79,12 +103,24 @@ class FaultSpec:
             raise ValueError(f"unknown fault hook {self.where!r}")
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
-        if self.kind in ("kill", "pause") and self.where != "node":
+        if self.kind in NODE_KINDS and self.where != "node":
             raise ValueError(f"{self.kind!r} is a node-level fault")
+        if ((self.heal_after_s is not None or self.after_s)
+                and self.where != "node"):
+            raise ValueError("fault windows (after_s/heal_after_s) apply "
+                             "to node-level faults only")
+        if self.heal_after_s is not None and self.heal_after_s <= 0:
+            raise ValueError("heal_after_s must be positive")
 
     def matches(self, target: str, mtype: str) -> bool:
         return (fnmatch.fnmatchcase(target, self.target)
                 and (self.mtype == "*" or self.mtype == mtype))
+
+    def window(self) -> tuple[float, Optional[float]]:
+        """(down_at, up_at) on the plan clock; up_at None = permanent."""
+        up = (None if self.heal_after_s is None
+              else self.after_s + self.heal_after_s)
+        return (self.after_s, up)
 
 
 class FaultPlan:
@@ -92,13 +128,19 @@ class FaultPlan:
 
     Thread-safe: transport handler threads and client threads consult the
     plan concurrently; all draw/counter state mutates under one lock.
+    ``clock`` (default ``time.monotonic``) drives fault-episode windows;
+    tests inject a fake clock to step time deterministically.
     """
 
-    def __init__(self, seed: int = 0, specs=()):
+    def __init__(self, seed: int = 0, specs=(),
+                 clock: Callable[[], float] = time.monotonic):
         self.seed = int(seed)
         self.specs: list[FaultSpec] = []
-        self._killed: set[str] = set()
+        self._clock = clock
+        self._t0 = clock()
+        self._killed: dict[str, Optional[float]] = {}  # name -> heal time
         self._node_verdicts: dict[tuple[int, str], bool] = {}
+        self._pair_verdicts: dict[tuple[int, str, str], bool] = {}
         self._seq: dict[tuple[int, str], int] = {}       # draw counters
         self._fired_by: dict[tuple[int, str], int] = {}  # per-target caps
         self._lock = named_lock("faultplan_lock")
@@ -110,37 +152,68 @@ class FaultPlan:
             self.specs.append(spec)
         return spec
 
-    # -- node-level state ------------------------------------------------
-    def kill(self, name: str) -> None:
-        """Hard-kill: the node's server closes every connection without
-        answering, and clients refuse to dial it."""
+    # -- episode clock ---------------------------------------------------
+    def elapsed(self) -> float:
+        """Seconds since the plan epoch (construction or reset_epoch)."""
+        return self._clock() - self._t0
+
+    def reset_epoch(self) -> None:
+        """Restart the episode timeline at zero — soak harnesses call
+        this right before the measured run so ``after_s`` offsets are
+        relative to the run, not to plan construction."""
         with self._lock:
-            self._killed.add(name)
+            self._t0 = self._clock()
+
+    def _live(self, s: FaultSpec, now: float) -> bool:
+        # caller holds the lock; window gate for node-level episodes
+        down, up = s.window()
+        return down <= now and (up is None or now < up)
+
+    # -- node-level state ------------------------------------------------
+    def kill(self, name: str, heal_after_s: Optional[float] = None) -> None:
+        """Hard-kill: the node's server closes every connection without
+        answering, and clients refuse to dial it. With ``heal_after_s``
+        the kill is an episode — the node revives on its own once the
+        window elapses."""
+        with self._lock:
+            self._killed[name] = (None if heal_after_s is None
+                                  else self.elapsed() + heal_after_s)
 
     def revive(self, name: str) -> None:
         with self._lock:
-            self._killed.discard(name)
+            self._killed.pop(name, None)
 
     def killed(self, name: str) -> bool:
         with self._lock:
+            now = self.elapsed()
             if name in self._killed:
-                return True
-            return self._node_verdict(name, "kill") is not None
+                heal_at = self._killed[name]
+                if heal_at is None or now < heal_at:
+                    return True
+                del self._killed[name]   # window elapsed: healed
+            return self._node_verdict(name, "kill", now) is not None
 
     def node_fault(self, name: str) -> Optional[FaultSpec]:
-        """The node-level spec (kill or pause) applying to ``name``, if
-        any. Verdicts are drawn once per (spec, node) and memoized — a
-        node is dead or alive for the whole run, never flapping."""
+        """The node-level spec (kill or pause) applying to ``name`` right
+        now, if any. Membership draws are keyed per (spec, node) and
+        memoized; the spec's episode window decides liveness, so a
+        heal-less spec keeps the legacy contract — dead or alive for the
+        whole run, never flapping."""
         with self._lock:
+            now = self.elapsed()
             if name in self._killed:
-                return FaultSpec(where="node", kind="kill", target=name)
+                heal_at = self._killed[name]
+                if heal_at is None or now < heal_at:
+                    return FaultSpec(where="node", kind="kill", target=name)
+                del self._killed[name]
             for kind in ("kill", "pause"):
-                s = self._node_verdict(name, kind)
+                s = self._node_verdict(name, kind, now)
                 if s is not None:
                     return s
         return None
 
-    def _node_verdict(self, name: str, kind: str) -> Optional[FaultSpec]:
+    def _node_verdict(self, name: str, kind: str,
+                      now: float) -> Optional[FaultSpec]:
         # caller holds the lock
         for i, s in enumerate(self.specs):
             if s.where != "node" or s.kind != kind:
@@ -153,9 +226,62 @@ class FaultPlan:
                     s.prob >= 1.0
                     or float(np.random.default_rng(
                         (self.seed, i, _name_key(name))).random()) < s.prob)
-            if self._node_verdicts[key]:
+            if self._node_verdicts[key] and self._live(s, now):
                 return s
         return None
+
+    def partitioned(self, a: str, b: str) -> bool:
+        """True if the link between ``a`` and ``b`` is currently cut by a
+        live partition episode. Symmetric (a bidirectional cut): a spec
+        applies if either orientation matches target×peer. Membership is
+        one seeded draw per (spec, unordered pair), so whether a given
+        link is in the blast radius never depends on which side dialed
+        first."""
+        if a == b:
+            return False
+        with self._lock:
+            now = self.elapsed()
+            for i, s in enumerate(self.specs):
+                if s.kind != "partition":
+                    continue
+                hit = ((fnmatch.fnmatchcase(a, s.target)
+                        and fnmatch.fnmatchcase(b, s.peer))
+                       or (fnmatch.fnmatchcase(b, s.target)
+                           and fnmatch.fnmatchcase(a, s.peer)))
+                if not hit:
+                    continue
+                lo, hi = sorted((a, b))
+                key = (i, lo, hi)
+                if key not in self._pair_verdicts:
+                    self._pair_verdicts[key] = (
+                        s.prob >= 1.0
+                        or float(np.random.default_rng(
+                            (self.seed, i, _name_key(lo),
+                             _name_key(hi))).random()) < s.prob)
+                if self._pair_verdicts[key] and self._live(s, now):
+                    return True
+        return False
+
+    def episodes(self) -> list[dict]:
+        """The deterministic down/up timeline: one row per node-level
+        spec plus one per explicit kill, each with the window on the plan
+        clock (``heal_s`` None = permanent). Soak harnesses diff this
+        across same-seed runs to assert identical fault timelines."""
+        with self._lock:
+            out = []
+            for i, s in enumerate(self.specs):
+                if s.where != "node":
+                    continue
+                down, up = s.window()
+                out.append({"spec": i, "kind": s.kind, "target": s.target,
+                            "peer": s.peer if s.kind == "partition"
+                            else None,
+                            "down_s": down, "heal_s": up})
+            for name in sorted(self._killed):
+                out.append({"spec": None, "kind": "kill", "target": name,
+                            "peer": None, "down_s": 0.0,
+                            "heal_s": self._killed[name]})
+        return out
 
     # -- link-level draws ------------------------------------------------
     def pick(self, where: str, target: str,
@@ -189,8 +315,16 @@ class FaultPlan:
 
     def describe(self) -> str:
         with self._lock:
-            rows = [f"{s.where}/{s.kind} target={s.target} mtype={s.mtype} "
-                    f"p={s.prob} fired={s.fired}" for s in self.specs]
+            rows = []
+            for s in self.specs:
+                row = (f"{s.where}/{s.kind} target={s.target} "
+                       f"mtype={s.mtype} p={s.prob} fired={s.fired}")
+                if s.kind == "partition":
+                    row += f" peer={s.peer}"
+                if s.heal_after_s is not None or s.after_s:
+                    down, up = s.window()
+                    row += f" window=[{down},{'inf' if up is None else up})"
+                rows.append(row)
             if self._killed:
                 rows.append(f"killed={sorted(self._killed)}")
         return f"FaultPlan(seed={self.seed}): " + ("; ".join(rows) or "empty")
@@ -211,4 +345,4 @@ def set_fault_plan(plan: Optional[FaultPlan]) -> None:
 
 
 __all__ = ["FaultSpec", "FaultPlan", "fault_plan", "set_fault_plan",
-           "KINDS", "WHERES"]
+           "KINDS", "WHERES", "NODE_KINDS"]

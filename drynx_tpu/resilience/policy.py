@@ -134,6 +134,23 @@ REFILL_MAX_SLABS_STEP = 4
 # failure surfaces as the survey's error.
 RESUME_MAX_RETRIES = 1
 
+# -- partition-tolerance knobs (PR 17) ---------------------------------------
+# probe_liveness verdicts go stale the moment a healing fault window
+# closes; resume paths cache a probe for at most this long before
+# re-probing automatically, so a checkpointed re-entry never dispatches
+# on a dead-then-healed roster view. DRYNX_PROBE_TTL overrides.
+PROBE_TTL_S = 2.0
+# Checkpointed re-entry: how many times a survey that failed mid-phase
+# may resume from its durable checkpoint before the error surfaces.
+# Higher than RESUME_MAX_RETRIES (pre-dispatch failures) because a
+# healing partition legitimately fails the same survey more than once
+# while the window is open.
+CHECKPOINT_MAX_RESUMES = 3
+# How long a resume waits before re-probing after a mid-phase transport
+# failure — gives a healing fault window a chance to close instead of
+# burning a bounded retry on a still-open partition.
+RESUME_BACKOFF_S = 0.5
+
 # -- idempotency table ------------------------------------------------------
 # Read-only or set-once-overwrite handlers: re-execution is harmless.
 IDEMPOTENT_MTYPES = frozenset({
@@ -247,4 +264,5 @@ __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
            "VERIFY_WORKERS", "TENANT_QUOTA", "SHED_FRACTION",
            "SHED_RETRY_MIN_S", "SHED_RETRY_MAX_S", "RATE_WINDOW_EVENTS",
            "REFILL_HORIZON_S", "REFILL_MAX_SLABS_STEP",
-           "RESUME_MAX_RETRIES"]
+           "RESUME_MAX_RETRIES", "PROBE_TTL_S", "CHECKPOINT_MAX_RESUMES",
+           "RESUME_BACKOFF_S"]

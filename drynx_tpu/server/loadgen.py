@@ -28,6 +28,7 @@ run against a real LocalCluster in scripts/bench_load.py instead.
 from __future__ import annotations
 
 import dataclasses
+import random
 import threading
 import time
 import types
@@ -189,11 +190,16 @@ class LoadGen:
     per-request rows."""
 
     def __init__(self, server, shapes: list[ShapeMix] | None = None,
-                 tenants: dict[str, float] | None = None, seed: int = 0):
+                 tenants: dict[str, float] | None = None, seed: int = 0,
+                 query_fn=None):
         self.server = server
         self.shapes = list(shapes or [ShapeMix("base")])
         self.tenants = dict(tenants or {"default": 1.0})
         self.seed = seed
+        # query_fn(sid, shape) -> SurveyQuery: soak harnesses drive a
+        # REAL cluster under the generator by synthesizing full survey
+        # queries instead of the admission-surface stubs
+        self.query_fn = query_fn
         self.records: list[Record] = []
         self._recs: dict[str, Record] = {}
         self._events: dict[str, threading.Event] = {}
@@ -231,7 +237,9 @@ class LoadGen:
         tenant, shape = self._draw(n)
         sid = (f"{tenant}-{shape.name}-{n}" if attempt == 0
                else f"{tenant}-{shape.name}-{n}r{attempt}")
-        sq = synthetic_query(sid, proofs=shape.proofs, ranges=shape.ranges)
+        sq = (self.query_fn(sid, shape) if self.query_fn is not None
+              else synthetic_query(sid, proofs=shape.proofs,
+                                   ranges=shape.ranges))
         rec = Record(survey_id=sid, tenant=tenant, shape=shape.name,
                      proofs=shape.proofs, t_offer=t_offer)
         ev = threading.Event()
@@ -290,7 +298,11 @@ class LoadGen:
         offers the next — the classic closed loop whose steady state
         finds the server's saturation throughput. A rejected offer backs
         off (the Overloaded retry-after hint, clamped) and re-offers as
-        a fresh attempt, so rejections stay typed and counted."""
+        a fresh attempt, so rejections stay typed and counted. The
+        backoff is jittered by a seeded policy RNG (same derivation as
+        resilience.RetryPolicy) so a fleet of shed queriers does not
+        re-offer in lockstep at exactly ``retry_after_s`` — while two
+        same-seed runs still sleep identical schedules."""
         stop = threading.Event()
         counter = {"n": 0}
         active = {"n": concurrency}
@@ -313,6 +325,13 @@ class LoadGen:
                     attempt += 1
                     wait = (rec.retry_after_s
                             if rec.outcome == "shed" else rp.POLL_INTERVAL_S)
+                    # seeded +/- BACKOFF_JITTER fraction, keyed per
+                    # (querier slot, attempt) like RetryPolicy._delay —
+                    # de-synchronizes the re-offer herd deterministically
+                    r = random.Random((self.seed * 1_000_003 + n)
+                                      * 1_000_003 + attempt)
+                    wait *= 1.0 + rp.BACKOFF_JITTER * (2.0 * r.random()
+                                                       - 1.0)
                     time.sleep(min(max(wait, rp.POLL_INTERVAL_S),
                                    max_backoff_s))
                 if think_s > 0:

@@ -462,16 +462,28 @@ class SurveyServer:
             except Exception as exc:
                 self.timers.span(f"Pipeline.encode.{sid}",
                                  t0, time.perf_counter())
-                if e.retries < rp.RESUME_MAX_RETRIES:
-                    # survey resume (minimal slice): re-probe liveness,
-                    # carry the responder set, re-enter the queue ONCE.
-                    # The retry bypasses admission gates — the survey
-                    # was already admitted and never logically left.
+                budget = self._resume_budget(sid)
+                if e.retries < budget:
+                    # survey resume: re-probe liveness, carry the
+                    # responder set, re-enter the queue. The retry
+                    # bypasses admission gates — the survey was already
+                    # admitted and never logically left. A survey with a
+                    # phase checkpoint gets CHECKPOINT_MAX_RESUMES
+                    # re-entries (each resumes from the recorded phase,
+                    # not from scratch); one without keeps the legacy
+                    # single retry.
                     e.retries += 1
+                    if budget > rp.RESUME_MAX_RETRIES:
+                        # checkpointed lane: pace the passes so the
+                        # retry budget spans a healing fault window
+                        # instead of burning out in milliseconds —
+                        # re-probing only makes sense once the world
+                        # has had time to move
+                        time.sleep(rp.RESUME_BACKOFF_S)
                     e.responders = self._reprobe()
                     log.warn(f"server: survey {sid} failed in dispatch "
-                             f"({exc}); re-queued with "
-                             f"responders={e.responders}")
+                             f"({exc}); re-queued (retry {e.retries}) "
+                             f"with responders={e.responders}")
                     with self._lock:
                         self._requeue_locked(e)
                     continue
@@ -493,6 +505,21 @@ class SurveyServer:
             self._verify_q.put(pendings)
         else:
             self._verify_group(pendings)
+
+    def _resume_budget(self, sid: str) -> int:
+        """Retry cap for the resume lane: CHECKPOINT_MAX_RESUMES when the
+        cluster holds a phase checkpoint for this survey (re-entry resumes
+        mid-survey instead of restarting, so more attempts are cheap and
+        safe — the checkpoint's absolute counters keep VN gates and reply
+        caches idempotent), else the legacy RESUME_MAX_RETRIES."""
+        ckfor = getattr(self.cluster, "checkpoint_for", None)
+        if ckfor is not None:
+            try:
+                if ckfor(sid) is not None:
+                    return rp.CHECKPOINT_MAX_RESUMES
+            except Exception:
+                pass
+        return rp.RESUME_MAX_RETRIES
 
     def _reprobe(self) -> tuple | None:
         """The resume re-triage: the cluster's concurrent liveness probe

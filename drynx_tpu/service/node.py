@@ -63,6 +63,7 @@ from ..utils import log
 from . import topology as topo
 from .proof_collection import VerifyingNode
 from .skipchain import DataBlock
+from .store import ProofDB, SurveyCheckpoint
 from .transport import (ConnectError, Conn, NodeServer, RemoteError,
                         TransportError, conn_pool, current_node,
                         link_model, pack_array, set_current_node,
@@ -79,6 +80,15 @@ def _net_delta(before: dict, after: dict) -> dict:
             "msgs_total": after["msgs_total"] - before["msgs_total"],
             "by_peer": {k: v for k, v in peers.items() if v},
             "rx_by_node": {k: v for k, v in rx.items() if v}}
+
+
+def _probe_ttl() -> float:
+    """probe_liveness verdict lifetime: within it, resume paths reuse
+    the cached alive/dead map; past it they re-probe automatically (a
+    healing fault window can flip a verdict at any moment).
+    DRYNX_PROBE_TTL overrides rp.PROBE_TTL_S per process."""
+    env = os.environ.get("DRYNX_PROBE_TTL", "").strip()
+    return float(env) if env else rp.PROBE_TTL_S
 
 
 def _pack_bytes(b: bytes) -> dict:
@@ -291,6 +301,16 @@ class DrynxNode:
         # bytes instead of re-encrypting, so a contribution can never be
         # double-counted and its range proof never double-fires.
         self._dp_replies: dict[str, dict] = {}
+        # Root CN role: per-survey phase checkpoints (PR 17). In-memory
+        # always; durable through store.ProofDB when DRYNX_CKPT_PERSIST
+        # is set (the soak harness and cmd/server deployments turn it
+        # on), so a restarted root resumes accounting instead of
+        # restarting it. Probe verdicts are cached per DP for
+        # _probe_ttl() seconds so a healing-window re-entry never
+        # dispatches on a stale liveness map.
+        self._ckpts: dict[str, SurveyCheckpoint] = {}
+        self._ckpt_db: Optional[ProofDB] = None
+        self._probe_cache: dict[str, tuple[float, bool]] = {}
         self._state_lock = rp.named_lock("node_state_lock")  # handlers run on server threads
 
         s = self.server
@@ -812,6 +832,131 @@ class DrynxNode:
             return self.server.handlers[msg["type"]](msg)
         return call_entry(entry, msg, policy=self.policy)
 
+    # -- root CN: durable phase checkpoints + healing-window re-entry ----
+    def _ckpt_store(self) -> Optional[ProofDB]:
+        if (self._ckpt_db is None
+                and os.environ.get("DRYNX_CKPT_PERSIST", "").strip()):
+            self._ckpt_db = ProofDB(self._db_path + ".ckpt")
+        return self._ckpt_db
+
+    def _checkpoint(self, sid: str) -> SurveyCheckpoint:
+        """This survey's checkpoint record: fresh on first entry, the
+        surviving record (memory first, then the durable store — a
+        restarted root finds it there) on re-entry, with ``resumes``
+        bumped so phase counters distinguish a resume from a restart."""
+        with self._state_lock:
+            ck = self._ckpts.get(sid)
+            if ck is None:
+                ck = SurveyCheckpoint.load(self._ckpt_store(), sid)
+            if ck is None:
+                ck = SurveyCheckpoint(survey_id=sid)
+            elif not ck.done:
+                ck.resumes += 1
+            # bound like the DP reply cache: prune finished foreign
+            # surveys in insertion order
+            for k in list(self._ckpts):
+                if len(self._ckpts) < rp.DP_REPLY_CACHE_MAX:
+                    break
+                if self._ckpts[k].done and k != sid:
+                    del self._ckpts[k]
+            self._ckpts[sid] = ck
+            return ck
+
+    def _ckpt_enter(self, ck: SurveyCheckpoint, phase: str) -> None:
+        ck.enter(phase)
+        ck.save(self._ckpt_store())
+
+    def _probe_dp(self, entry) -> bool:
+        """TTL-cached liveness probe for one roster entry (resume path):
+        an ALIVE verdict older than _probe_ttl() re-probes automatically,
+        so a re-entry never dispatches on a map drawn before a fault
+        window moved. DEAD verdicts are never cached — the healing loop's
+        passes are spaced tighter than the TTL, and a pinned negative
+        would hide a node that revived between passes (the only cost of
+        not caching is one PING_TIMEOUT_S per pass, on an already
+        degraded survey)."""
+        now = time.monotonic()
+        with self._state_lock:
+            hit = self._probe_cache.get(entry.name)
+            if hit is not None and now - hit[0] < _probe_ttl():
+                return True
+        pol = dataclasses.replace(self.policy,
+                                  call_timeout_s=rp.PING_TIMEOUT_S,
+                                  connect_retries=0)
+        try:
+            alive = bool(call_entry(entry, {"type": "ping"},
+                                    policy=pol).get("ok"))
+        except (TransportError, OSError):
+            alive = False
+        with self._state_lock:
+            if alive:
+                self._probe_cache[entry.name] = (time.monotonic(), True)
+            else:
+                self._probe_cache.pop(entry.name, None)
+        return alive
+
+    def _dispatch_star(self, dps, dp_frame: dict):
+        """Flat DP fan-out; same result shape as _dispatch_tree so the
+        re-entry pass composes over either topology."""
+        outs = fan_out(dps, lambda e: dict(dp_frame), policy=self.policy)
+        partials, responders, failed = [], [], []
+        for e, (r, err) in zip(dps, outs):
+            if err is None:
+                responders.append(e.name)
+                partials.append(unpack_array(r["cts"]))
+            elif isinstance(err, RemoteError):
+                raise err   # the DP's handler ran and errored: a real
+                            # bug, not an availability fault
+            elif isinstance(err, (TransportError, OSError)):
+                log.warn(f"{self.name}: DP {e.name} unavailable for "
+                         f"survey {dp_frame['survey_id']}: {err}")
+                failed.append(e.name)
+            else:
+                raise err
+        return partials, responders, sorted(failed), []
+
+    def _redispatch_missing(self, dps, dp_frame: dict, proofs: bool,
+                            mode: str, partials, responders, failed,
+                            blobs, ck: SurveyCheckpoint):
+        """Mid-survey healing re-entry: while contributions are missing,
+        checkpoint, wait out part of the fault window, re-probe ONLY the
+        missing DPs (TTL-cached verdicts), and re-dispatch only those
+        that answer — over a survivor-layout tree when more than one
+        heals (a dead interior relay's subtree re-parents onto the new
+        layout), a flat fan-out otherwise. Partials stay disjoint by
+        construction (a DP is re-dialed only while absent), and the DP
+        reply cache replays byte-identical bytes for any DP that
+        contributed before dying, so re-entry can never double-count.
+        Bounded by rp.CHECKPOINT_MAX_RESUMES passes."""
+        by_name = {e.name: e for e in dps}
+        order = [e.name for e in dps]
+        attempt = 0
+        failed = set(failed)
+        while failed and attempt < rp.CHECKPOINT_MAX_RESUMES:
+            attempt += 1
+            time.sleep(rp.RESUME_BACKOFF_S)
+            healed = [nm for nm in sorted(failed)
+                      if self._probe_dp(by_name[nm])]
+            if not healed:
+                continue
+            log.lvl1(f"{self.name}: survey {dp_frame['survey_id']} "
+                     f"re-entering collect for healed DPs {healed} "
+                     f"(pass {attempt})")
+            self._ckpt_enter(ck, "collect")
+            retry = [by_name[nm]
+                     for nm in topo.survivor_layout(order, healed)]
+            if mode == "tree" and len(retry) > 1:
+                p2, r2, _f2, b2 = self._dispatch_tree(retry, dp_frame,
+                                                      proofs)
+            else:
+                p2, r2, _f2, b2 = self._dispatch_star(retry, dp_frame)
+            partials += p2
+            blobs += b2
+            got = set(responders) | set(r2)
+            responders = [nm for nm in order if nm in got]
+            failed -= set(r2)
+        return partials, responders, sorted(failed), blobs
+
     def _dispatch_tree(self, dps, dp_frame: dict, proofs: bool):
         """Tree-overlay DP dispatch from the root: contact the forest
         roots, let relays fold their subtrees, and recover from a dead
@@ -907,12 +1052,14 @@ class DrynxNode:
         min_q = int(msg.get("min_dp_quorum") or 0)
         need = min_q if min_q > 0 else len(dps)
         mode = topo.topology_mode()
+        ck = self._checkpoint(survey_id)
         log.lvl1(f"{self.name}: survey {survey_id} op={op} "
                  f"dps={len(dps)} cns={len(cns)} proofs={int(proofs)} "
-                 f"quorum={need} topology={mode}")
+                 f"quorum={need} topology={mode} resumes={ck.resumes}")
 
         # range-signature setup: every CN publishes its BB digit signatures
         # for each distinct base u in the query's ranges
+        self._ckpt_enter(ck, "setup")
         range_sigs_msg: dict = {}
         if proofs and ranges_v:
             for (u, _l) in rproof.group_ranges(ranges_v):
@@ -942,29 +1089,23 @@ class DrynxNode:
                     "range_offset": range_offset,
                     "proofs": proofs, "ranges": ranges_v,
                     "range_sigs": range_sigs_msg}
-        blobs: list[dict] = []
+        self._ckpt_enter(ck, "collect")
         if mode == "tree" and len(dps) > 1:
             (partials, responders,
              failed, blobs) = self._dispatch_tree(dps, dp_frame, proofs)
         else:
-            outs = fan_out(dps, lambda e: dict(dp_frame),
-                           policy=self.policy)
-            partials = []
-            responders, failed = [], []
-            for e, (r, err) in zip(dps, outs):
-                if err is None:
-                    responders.append(e.name)
-                    partials.append(unpack_array(r["cts"]))
-                elif isinstance(err, RemoteError):
-                    raise err   # the DP's handler ran and errored: a real
-                                # bug, not an availability fault
-                elif isinstance(err, (TransportError, OSError)):
-                    log.warn(f"{self.name}: DP {e.name} unavailable for "
-                             f"survey {survey_id}: {err}")
-                    failed.append(e.name)
-                else:
-                    raise err
+            (partials, responders,
+             failed, blobs) = self._dispatch_star(dps, dp_frame)
+        if failed:
+            # mid-survey healing re-entry: checkpointed, probe-gated,
+            # bounded — only the missing sub-work is re-dispatched
+            (partials, responders,
+             failed, blobs) = self._redispatch_missing(
+                dps, dp_frame, proofs, mode, partials, responders,
+                failed, blobs, ck)
+        ck.responders = list(responders)
         if len(responders) < need:
+            ck.save(self._ckpt_store())
             raise RuntimeError(
                 f"survey {survey_id}: only {len(responders)}/{len(dps)} DPs "
                 f"responded (quorum {need}); failed: {sorted(failed)}")
@@ -991,6 +1132,8 @@ class DrynxNode:
         # and star payloads land on identical aggregate bytes, which is
         # what makes the final transcripts byte-comparable across
         # topologies (ISSUE 11 acceptance gate)
+        ck.absent = list(absent)
+        self._ckpt_enter(ck, "aggregate")
         cts = jnp.asarray(np.stack(partials))  # (n_partials, V, 2, 3, 16)
         agg = topo.fold_cts(cts)
         if proofs:
@@ -1008,6 +1151,7 @@ class DrynxNode:
         # each CN consumes the previous CN's output ciphertexts, so the
         # crypto forces sequential dispatch — fan_out does not apply.
         if msg.get("obfuscation"):
+            self._ckpt_enter(ck, "obfuscate")
             for e in cns:
                 r = self._call_cn(e, {"type": "obf_contrib",
                                       "survey_id": survey_id,
@@ -1020,6 +1164,7 @@ class DrynxNode:
         # lands on each result (reference service.go:600-665,809-851)
         diffp = msg.get("diffp") or {}
         if diffp.get("noise_list_size", 0) > 0:
+            self._ckpt_enter(ck, "dro")
             noise = dro.generate_noise_values(
                 int(diffp["noise_list_size"]), float(diffp["lap_mean"]),
                 float(diffp["lap_scale"]), float(diffp["quanta"]),
@@ -1040,6 +1185,7 @@ class DrynxNode:
         # key switch: gather contributions from every CN (including self).
         # A star round — every CN switches the SAME K0 component — so it
         # fans out; the point sums accumulate in roster order below.
+        self._ckpt_enter(ck, "keyswitch")
         K0 = np.asarray(agg[:, 0])
         ks_frame = {"type": "ks_contrib", "k_component": pack_array(K0),
                     "client_pub": list(msg["client_pub"]),
@@ -1072,8 +1218,12 @@ class DrynxNode:
             drained = self._proof_threads.pop(survey_id, [])
         for t in drained:
             t.join(timeout=rp.PROOF_DRAIN_S)
+        ck.done = True
+        self._ckpt_enter(ck, "done")
         return {"switched": pack_array(np.asarray(switched)),
-                "responders": responders, "absent": absent}
+                "responders": responders, "absent": absent,
+                "resumes": ck.resumes,
+                "phases": dict(ck.phase_entries)}
 
     # -- VN handlers
     def _h_vn_register(self, msg: dict) -> dict:
@@ -1449,6 +1599,13 @@ class RemoteClient:
         # Populated by run_survey when proofs/quorum bookkeeping runs.
         self.last_responders: list[str] = []
         self.last_absent: list[str] = []
+        # Root-side resume accounting from the last survey reply: how
+        # many checkpointed re-entries the root took, and its per-phase
+        # entry counters (soak harnesses assert "resumed, not
+        # restarted" on these).
+        self.last_resumes: int = 0
+        self.last_phases: dict = {}
+        self._probe_cache: Optional[tuple[float, dict]] = None
         # Per-survey LinkModel byte accounting (delta over run_survey):
         # {"bytes_total", "msgs_total", "by_peer"} — zeros with no link
         # model configured beyond the counters themselves.
@@ -1499,11 +1656,22 @@ class RemoteClient:
         """Ping every roster entry CONCURRENTLY; map node name -> alive.
         Dead nodes each burn a connect timeout — fanned out, a roster
         full of corpses costs one timeout, not one per corpse. This is
-        the re-probe hook survey resume builds on (ROADMAP item 6)."""
+        the re-probe hook survey resume builds on (ROADMAP item 6).
+
+        Verdicts carry a TTL (_probe_ttl): resume paths calling back
+        within it reuse the map; past it the probe re-runs automatically,
+        so no dispatch ever rides a verdict drawn before a healing fault
+        window moved."""
+        now = time.monotonic()
+        if (self._probe_cache is not None
+                and now - self._probe_cache[0] < _probe_ttl()):
+            return dict(self._probe_cache[1])
         outs = fan_out(self.roster.entries, lambda e: {"type": "ping"},
                        call=lambda e, m: self.ping(e))
-        return {e.name: bool(r) for e, (r, _err)
-                in zip(self.roster.entries, outs)}
+        alive = {e.name: bool(r) for e, (r, _err)
+                 in zip(self.roster.entries, outs)}
+        self._probe_cache = (time.monotonic(), alive)
+        return alive
 
     def expected_proofs(self, n_dps: int, n_cns: int, obfuscation: bool,
                         diffp: bool) -> int:
@@ -1650,6 +1818,8 @@ class RemoteClient:
                        timeout=max(timeout, rp.CALL_TIMEOUT_S))
         self.last_responders = list(r.get("responders") or [])
         self.last_absent = list(r.get("absent") or [])
+        self.last_resumes = int(r.get("resumes") or 0)
+        self.last_phases = dict(r.get("phases") or {})
         switched = unpack_array_device(r["switched"])
         dl = dlog or eg.DecryptionTable(limit=10000)
         xq = jnp.asarray(eg.secret_to_limbs(self.secret))

@@ -49,6 +49,7 @@ from ..utils import log
 from ..utils.timers import PhaseTimers
 from . import topology as topo
 from .proof_collection import VerifyCache, VerifyingNode, VNGroup
+from .store import ProofDB, SurveyCheckpoint
 from .query import (DiffPParams, Operation, Query, SurveyQuery,
                     check_parameters, choose_operation, query_to_proofs_nbrs)
 
@@ -203,6 +204,14 @@ class LocalCluster:
 
         self.range_sigs: dict[int, list[rproof.RangeSig]] = {}
         self.surveys: dict[str, Survey] = {}
+        # Per-survey phase checkpoints (PR 17): execute_survey records
+        # phase entries here; the scheduler's resume lane reads them to
+        # re-enter a failed survey instead of restarting it, and the
+        # soak harness asserts resume-not-restart on the counters.
+        # attach_checkpoint_store() makes them durable via store.ProofDB.
+        self.checkpoints: dict[str, SurveyCheckpoint] = {}
+        self.checkpoint_db = None
+        self._probe_cache: Optional[tuple] = None
         # serializes proof threads' device work (see _async_proof)
         self._proof_device_lock = rp.named_lock("proof_device_lock")
         self._aot_mode = precompile
@@ -546,24 +555,56 @@ class LocalCluster:
     def run_survey(self, sq: SurveyQuery, seed: int = 0):
         return self.finalize_survey(self.execute_survey(sq, seed))
 
+    def attach_checkpoint_store(self, path: str) -> None:
+        """Make survey checkpoints durable: phase records persist to a
+        store.ProofDB at ``path`` so a restarted root process resumes
+        accounting (and in-flight surveys) instead of restarting them."""
+        self.checkpoint_db = ProofDB(path)
+
+    def checkpoint_for(self, survey_id: str) -> Optional[SurveyCheckpoint]:
+        ck = self.checkpoints.get(survey_id)
+        if ck is None:
+            ck = SurveyCheckpoint.load(self.checkpoint_db, survey_id)
+            if ck is not None:
+                self.checkpoints[survey_id] = ck
+        return ck
+
     def probe_liveness(self) -> dict:
         """Concurrent DP liveness probe — the survey-resume re-triage hook
         (ROADMAP item 6): one ping per DP over the fan_out pool through
         transport.local_call, so an active FaultPlan's connect/node hooks
         decide reachability exactly as a TCP probe would. Without a plan
-        every in-process DP is trivially alive."""
+        every in-process DP is trivially alive.
+
+        Verdicts carry a TTL (rp.PROBE_TTL_S / DRYNX_PROBE_TTL): calls
+        within it reuse the cached map, past it the probe re-runs — so a
+        resume never dispatches on a verdict drawn before a healing
+        fault window moved. The cache is keyed to the active plan
+        object; swapping plans invalidates it immediately."""
         from . import node as nd
         from . import transport as tr
 
         # DP names are public routing metadata (same declassification as
         # the execute_survey probe loop)
         names = [d.name for d in self.dp_idents]  # drynx: declassify[secret]
-        if faults.fault_plan() is None:
+        plan = faults.fault_plan()
+        if plan is None:
             return {n: True for n in names}
+        import os
+
+        env = os.environ.get("DRYNX_PROBE_TTL", "").strip()
+        ttl = float(env) if env else rp.PROBE_TTL_S
+        now = time.monotonic()
+        if (self._probe_cache is not None
+                and self._probe_cache[0] is plan
+                and now - self._probe_cache[1] < ttl):
+            return dict(self._probe_cache[2])
         outs = nd.fan_out(
             names, lambda n: None,
             call=lambda n, m: tr.local_call(n, "ping", lambda: True))
-        return {n: err is None for n, (_, err) in zip(names, outs)}
+        alive = {n: err is None for n, (_, err) in zip(names, outs)}
+        self._probe_cache = (plan, time.monotonic(), alive)
+        return alive
 
     def execute_survey(self, sq: SurveyQuery, seed: int = 0,
                        hold_range: bool = False, tenant: str = "default",
@@ -587,6 +628,24 @@ class LocalCluster:
         tm = survey.timers
         key = jax.random.PRNGKey(seed)
         proofs_on = q.proofs == 1 and self.vns is not None
+
+        # phase checkpoint (PR 17): first entry creates the record; a
+        # re-entry (scheduler resume lane after a mid-phase fault) finds
+        # it — in memory or the durable store — and bumps ``resumes``.
+        # Every phase entry below lands in ck.phase_entries, the
+        # resume-not-restart evidence the soak harness asserts on.
+        ck = self.checkpoint_for(sq.survey_id)
+        if ck is None:
+            ck = SurveyCheckpoint(survey_id=sq.survey_id)
+            self.checkpoints[sq.survey_id] = ck
+        elif not ck.done:
+            ck.resumes += 1
+
+        def mark(phase: str) -> None:
+            ck.enter(phase)
+            ck.save(self.checkpoint_db)
+
+        mark("probe")
 
         # --- Quorum-degraded membership: with an active FaultPlan every
         # DP dispatch rides transport.local_call, so the in-process path
@@ -627,10 +686,13 @@ class LocalCluster:
                 f"survey {sq.survey_id}: only {len(responders)}/"
                 f"{len(self.dp_idents)} DPs responded (quorum {need}); "
                 f"absent: {sorted(absent)}")
+        ck.responders = list(responders)
+        ck.absent = sorted(absent)
         log.lvl1(f"survey {sq.survey_id}: op={op.name} "
                  f"dps={len(responders)}/{len(self.dp_idents)} "
                  f"cns={len(self.cns)} "
-                 f"proofs={int(proofs_on)} groups={q.n_groups()}")
+                 f"proofs={int(proofs_on)} groups={q.n_groups()} "
+                 f"resumes={ck.resumes}")
 
         if proofs_on:
             nbrs = query_to_proofs_nbrs(sq)
@@ -650,6 +712,7 @@ class LocalCluster:
             self._warm_kernels(tm, q)
 
         # --- DP phase: encode + encrypt (+ range proofs) ----------------
+        mark("collect")
         tm.start("DataCollectionProtocol")
         dp_stats = np.stack([
             self.dps[d.name].local_stats(op, self.rng, q.group_by)
@@ -734,6 +797,7 @@ class LocalCluster:
                     lambda i=i: dp_lists()[i].to_bytes())
 
         # --- Aggregation phase (reference AggregationPhase :775) --------
+        mark("aggregate")
         tm.start("AggregationPhase")
         # canonical aggregate (topology.canon_points): the in-process
         # plane lands on the same aggregate BYTES as the remote tree/star
@@ -751,6 +815,7 @@ class LocalCluster:
 
         # --- Obfuscation phase (zero/nonzero ops only) ------------------
         if q.obfuscation:
+            mark("obfuscate")
             tm.start("ObfuscationPhase")
             obf_scalars = []
             work = agg
@@ -774,6 +839,7 @@ class LocalCluster:
         # --- DRO / differential privacy noise phase ---------------------
         noise_ct = None
         if q.diffp.enabled():
+            mark("dro")
             tm.start("DROPhase")
             d = q.diffp
             noise = dro.generate_noise_values(
@@ -836,6 +902,7 @@ class LocalCluster:
             tm.end("DROPhase")
 
         # --- Key switch to the querier's key ----------------------------
+        mark("keyswitch")
         tm.start("KeySwitchingPhase")
         srv_x = jnp.asarray(np.stack([eg.secret_to_limbs(c.secret)
                                       for c in self.cns]))
@@ -861,6 +928,7 @@ class LocalCluster:
                 self._async_proof(survey, "keyswitch", cn, ks_bytes)
 
         # --- Querier decrypt + decode -----------------------------------
+        mark("decrypt")
         tm.start("Decryption")
         xq = jnp.asarray(eg.secret_to_limbs(self.client.secret))
         dl = self.dlog
@@ -896,10 +964,16 @@ class LocalCluster:
                                dims=(op.nbr_input - 1)
                                if op.name == "lin_reg" else 1)
 
+        ck.responders = list(responders)
+        ck.absent = sorted(absent)
+        ck.done = True
+        mark("done")
+
         return PendingSurvey(survey=survey, sq=sq, result=result,
                              decrypted=dec, responders=responders,
                              absent=sorted(absent), proofs_on=proofs_on,
-                             hold_range=hold_range, tenant=tenant)
+                             hold_range=hold_range, tenant=tenant,
+                             checkpoint=ck)
 
     def finalize_survey(self, pending: "PendingSurvey"):
         """Join the survey's proof threads, end VN verification, and
@@ -933,12 +1007,15 @@ class LocalCluster:
                      f"{len(block.data.bitmap)} bitmap entries")
         log.lvl1(f"survey {sid}: done; phases: " + ", ".join(
             f"{k}={v:.3f}s" for k, v in tm.items()))
+        ck = pending.checkpoint
         return SurveyResult(result=pending.result,
                             decrypted=pending.decrypted, block=block,
                             timers=tm, survey_id=sid,
                             responders=pending.responders,
                             absent=pending.absent,
-                            tenant=pending.tenant)
+                            tenant=pending.tenant,
+                            resumes=ck.resumes if ck else 0,
+                            phases=dict(ck.phase_entries) if ck else {})
 
     # ------------------------------------------------------------------
     def _async_proof(self, survey: Survey, ptype: str, ident: NodeIdentity,
@@ -1052,6 +1129,7 @@ class PendingSurvey:
     proofs_on: bool
     hold_range: bool = False
     tenant: str = "default"    # fair-queueing lane key (server DRR/quota)
+    checkpoint: Optional[SurveyCheckpoint] = None  # phase ledger (PR 17)
 
 
 @dataclasses.dataclass
@@ -1065,6 +1143,11 @@ class SurveyResult:
     responders: list = dataclasses.field(default_factory=list)
     absent: list = dataclasses.field(default_factory=list)
     tenant: str = "default"
+    # resume accounting (PR 17): how many scheduler re-entries this survey
+    # took, and the checkpoint's per-phase entry counters (a clean run is
+    # resumes=0 with every counter at 1)
+    resumes: int = 0
+    phases: dict = dataclasses.field(default_factory=dict)
 
 
 def _pickle(obj) -> bytes:

@@ -9,6 +9,8 @@ pure-Python fallback with the same API keeps tests running.
 from __future__ import annotations
 
 import ctypes
+import dataclasses
+import json
 import os
 import threading
 
@@ -164,4 +166,63 @@ class ProofDB:
                 self._h = None
 
 
-__all__ = ["ProofDB"]
+_CKPT_PREFIX = b"ckpt:"
+
+
+@dataclasses.dataclass
+class SurveyCheckpoint:
+    """Durable per-survey phase checkpoint (ROADMAP item 6, PR 17).
+
+    One record per survey, overwritten (last-write-wins) at every phase
+    entry: which phase the state machine is in, which DPs have
+    contributed, and how many times each phase was entered. A mid-phase
+    transport failure leaves the record at the failed phase; the resume
+    lane re-enters with ``resumes`` bumped, and the phase counters are
+    how the soak harness asserts "resumed from checkpoint, not
+    restarted" (a restart would reset them). Persisted through
+    :class:`ProofDB` so a root process restart resumes too —
+    checkpoints ride the same append-only log as proofs, under the
+    ``ckpt:`` key prefix the proof paths never use.
+    """
+
+    survey_id: str
+    phase: str = "admitted"
+    responders: list = dataclasses.field(default_factory=list)
+    absent: list = dataclasses.field(default_factory=list)
+    resumes: int = 0
+    done: bool = False
+    phase_entries: dict = dataclasses.field(default_factory=dict)
+    progress: dict = dataclasses.field(default_factory=dict)
+
+    def enter(self, phase: str) -> "SurveyCheckpoint":
+        """Record entry into a phase (idempotent re-entries increment
+        the counter — that asymmetry is the resume evidence)."""
+        self.phase = phase
+        self.phase_entries[phase] = self.phase_entries.get(phase, 0) + 1
+        return self
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self),
+                          sort_keys=True).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "SurveyCheckpoint":
+        return cls(**json.loads(raw.decode()))
+
+    def save(self, db: "ProofDB | None") -> None:
+        if db is not None:
+            db.put(_CKPT_PREFIX + self.survey_id.encode(),
+                   self.to_bytes())
+
+    @classmethod
+    def load(cls, db: "ProofDB | None",
+             survey_id: str) -> "SurveyCheckpoint | None":
+        if db is None:
+            return None
+        raw = db.get(_CKPT_PREFIX + survey_id.encode())
+        if not raw:
+            return None
+        return cls.from_bytes(raw)
+
+
+__all__ = ["ProofDB", "SurveyCheckpoint"]

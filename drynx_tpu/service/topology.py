@@ -91,6 +91,20 @@ def subtree(i: int, n: int, b: int) -> list[int]:
     return out
 
 
+def survivor_layout(order: list, alive) -> list:
+    """Re-plan the overlay after mid-survey failures: the roster that a
+    fresh breadth-first tree should be built over, i.e. the surviving
+    names in original roster order. Compacting the dead indices out is
+    the whole failover — a dead interior relay's former descendants land
+    under live parents in the re-derived ``children()`` arithmetic, and
+    keeping roster order (not heal order) makes the re-planned layout a
+    pure function of WHICH nodes healed, never of when their probes
+    returned. Used by the root's re-entry pass (node.py
+    ``_redispatch_missing``) to dispatch only the missing sub-work."""
+    live = set(alive)
+    return [nm for nm in order if nm in live]
+
+
 def depth(n: int, b: int) -> int:
     """Number of levels in the overlay (1 = pure star of roots)."""
     d, level = 0, list(range(min(b, n)))
@@ -149,5 +163,5 @@ def fold_cts(stack):
 
 
 __all__ = ["topology_mode", "tree_fanout", "roots", "children", "parent",
-           "subtree", "depth", "canon_points", "fold_cts",
-           "ENV_TOPOLOGY", "ENV_FANOUT"]
+           "subtree", "survivor_layout", "depth", "canon_points",
+           "fold_cts", "ENV_TOPOLOGY", "ENV_FANOUT"]

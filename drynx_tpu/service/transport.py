@@ -800,6 +800,11 @@ class Conn:
             if plan.killed(self.peer):
                 raise ConnectError(f"connect to {self.peer} refused "
                                    f"(fault plan: node killed)")
+            src = current_node() or "client"
+            if plan.partitioned(src, self.peer):
+                raise ConnectError(
+                    f"connect {src} -> {self.peer} refused "
+                    f"(fault plan: partitioned)")
             act = plan.pick("connect", self.peer)
             if act is not None:
                 if act.kind == "delay":
@@ -847,6 +852,16 @@ class Conn:
             self.sent = False
             try:
                 plan = faults.fault_plan()
+                if (plan is not None
+                        and plan.partitioned(current_node() or "client",
+                                             self.peer)):
+                    # the link is cut under us: the frame never leaves,
+                    # so sent stays False and the retry policy treats it
+                    # as a connect-class failure (safe to re-dial later)
+                    self._mark_broken()
+                    raise ConnectionClosed(
+                        f"link to {self.peer} cut before {mtype!r} "
+                        f"(fault plan: partitioned)")
                 act = (plan.pick("request", self.peer, mtype)
                        if plan is not None else None)
                 if act is not None:
@@ -938,11 +953,20 @@ class ConnPool:
         # stacks hold (stamp, Conn); LIFO per key keeps the warmest
         # socket on top, the monotonic stamp orders LRU eviction globally
         self._idle: dict[tuple, list[tuple[int, Conn]]] = {}
+        # keys whose conns broke mid-exchange since their last fresh
+        # dial: a dead-or-partitioned peer's idle sockets can pass the
+        # MSG_PEEK health check (no FIN ever arrives through a cut
+        # link), so each checkout would hand out another doomed socket
+        # and burn a full call timeout. Once a FRESH dial to a suspect
+        # peer succeeds (the peer is demonstrably back), the whole stale
+        # idle stack for that key is purged instead.
+        self._suspect: set[tuple] = set()
         self._stamp = 0
         self.connects = 0
         self.reuses = 0
         self.discards = 0
         self.evictions = 0
+        self.purges = 0
 
     @staticmethod
     def _key(conn: Conn) -> tuple:
@@ -951,7 +975,14 @@ class ConnPool:
     def get(self, host: str, port: int,
             timeout: float = rp.CALL_TIMEOUT_S, peer: str = "") -> Conn:
         key = (peer or f"{host}:{port}", host, int(port))
-        while True:
+        with self._lock:
+            suspect = key in self._suspect
+        # a suspect key bypasses its idle stack entirely: those sockets
+        # pass MSG_PEEK (a cut link delivers no FIN) but each checkout
+        # would burn a full call timeout on a doomed exchange. Dial
+        # fresh instead — refusal fails fast and keeps the key suspect;
+        # success proves the peer is back and purges the stale stack.
+        while not suspect:
             with self._lock:
                 stack = self._idle.get(key)
                 conn = stack.pop()[1] if stack else None
@@ -964,8 +995,19 @@ class ConnPool:
                 return conn
             self.discard(conn)
         conn = Conn(host, port, timeout=timeout, peer=peer)
+        stale: list[Conn] = []
         with self._lock:
             self.connects += 1
+            if key in self._suspect:
+                self._suspect.discard(key)
+                stale = [c for _stamp, c in self._idle.pop(key, [])]
+                self.purges += len(stale)
+        for s in stale:
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+            s.closed = True
         return conn
 
     @staticmethod
@@ -1015,7 +1057,9 @@ class ConnPool:
                 pass
             v.closed = True
         if not pooled:
-            self.discard(conn)
+            # idle-depth overflow: the conn is healthy, just surplus —
+            # closing it must not condemn the peer's pooled sockets
+            self.discard(conn, suspect=False)
 
     def _pop_lru_locked(self) -> Optional[Conn]:
         """Remove and return the globally least-recently-pooled idle
@@ -1032,11 +1076,14 @@ class ConnPool:
             del self._idle[best_key]
         return conn
 
-    def discard(self, conn: Optional[Conn]) -> None:
+    def discard(self, conn: Optional[Conn], *,
+                suspect: bool = True) -> None:
         if conn is None:
             return
         with self._lock:
             self.discards += 1
+            if suspect:
+                self._suspect.add(self._key(conn))
         try:
             conn.sock.close()
         except OSError:
@@ -1062,7 +1109,7 @@ class ConnPool:
         with self._lock:
             return {"connects": self.connects, "reuses": self.reuses,
                     "discards": self.discards,
-                    "evictions": self.evictions,
+                    "evictions": self.evictions, "purges": self.purges,
                     "idle": sum(len(s) for s in self._idle.values())}
 
 
@@ -1126,6 +1173,10 @@ def local_call(peer: str, mtype: str, fn, *args, **kwargs):
     if plan.killed(peer):
         raise ConnectError(f"connect to {peer} refused "
                            f"(fault plan: node killed)")
+    src = current_node() or "client"
+    if plan.partitioned(src, peer):
+        raise ConnectError(f"connect {src} -> {peer} refused "
+                           f"(fault plan: partitioned)")
     act = plan.pick("connect", peer)
     if act is not None:
         if act.kind == "refuse":

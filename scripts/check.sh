@@ -71,4 +71,11 @@ echo "== load smoke (bursty open loop + adversarial mix over one supervised"
 echo "== child: zero lost, typed sheds with hints, bounded fairness) =="
 python scripts/bench_load.py --smoke > /dev/null
 
+echo "== soak smoke (pause/revive: seeded healing partition windows over a"
+echo "== 3-level tree roster in one supervised child + the -m soak mini-soak:"
+echo "== zero lost, checkpointed resume, results identical to clean run) =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m soak \
+    tests/test_server.py
+python scripts/bench_soak.py --smoke > /dev/null
+
 echo "check.sh: all green"

@@ -350,6 +350,65 @@ def test_conn_pool_health_check_discards_desynced_socket():
         srv.stop()
 
 
+def test_conn_pool_purges_suspect_peer_stack_on_redial():
+    """PR-17 satellite: a conn that breaks mid-exchange marks its peer
+    suspect. The peer's remaining pooled sockets can still pass MSG_PEEK
+    (a cut link never delivers a FIN), so a suspect key must bypass its
+    idle stack — and once a FRESH dial succeeds (the peer is
+    demonstrably back), the stale stack is purged rather than handed
+    out to burn one call timeout each."""
+    srv = _echo_server()
+    pool = ConnPool(max_idle=4)
+    try:
+        a = pool.get(srv.host, srv.port, peer="s")
+        b = pool.get(srv.host, srv.port, peer="s")
+        c = pool.get(srv.host, srv.port, peer="s")
+        for x in (a, b):
+            assert x.call({"type": "echo", "v": 0})["v"] == 0
+            pool.put(x)
+        assert pool.idle_count() == 2
+        # c breaks mid-exchange (timeout on a slow handler): peer suspect
+        c._timeout = 0.1
+        c.sock.settimeout(0.1)
+        with pytest.raises(CallTimeout):
+            c.call({"type": "slow"})
+        pool.discard(c)
+        # a and b still sit idle and still look healthy — but the next
+        # checkout must NOT trust them: fresh dial, stale stack purged
+        d = pool.get(srv.host, srv.port, peer="s")
+        assert d is not a and d is not b
+        st = pool.stats()
+        assert st["purges"] == 2 and pool.idle_count() == 0
+        assert a.closed and b.closed
+        assert d.call({"type": "echo", "v": 5})["v"] == 5
+        pool.put(d)
+        # suspicion cleared: the pooled socket is trusted again
+        assert pool.get(srv.host, srv.port, peer="s") is d
+        pool.close_all()
+    finally:
+        srv.stop()
+
+
+def test_conn_pool_overflow_close_does_not_condemn_peer():
+    """Idle-depth overflow closes a healthy surplus conn; that must not
+    mark the peer suspect (no purge storm on a busy healthy peer)."""
+    srv = _echo_server()
+    pool = ConnPool(max_idle=1)
+    try:
+        a = pool.get(srv.host, srv.port, peer="s")
+        b = pool.get(srv.host, srv.port, peer="s")
+        pool.put(a)
+        pool.put(b)            # overflow: closed, NOT suspect
+        assert pool.idle_count() == 1
+        c = pool.get(srv.host, srv.port, peer="s")
+        assert c is a          # the pooled socket is still trusted
+        assert pool.stats()["purges"] == 0
+        pool.put(c)
+        pool.close_all()
+    finally:
+        srv.stop()
+
+
 def test_call_entry_checks_out_of_process_pool():
     srv = _echo_server()
     try:

@@ -431,6 +431,26 @@ def test_resume_gives_up_after_max_retries(no_compile):
     assert len(attempts) == 1 + rp.RESUME_MAX_RETRIES
 
 
+def test_resume_budget_widens_for_checkpointed_surveys(no_compile):
+    """PR 17: a cluster holding a phase checkpoint for the survey gets
+    CHECKPOINT_MAX_RESUMES re-entries (each resumes mid-survey, not from
+    scratch); a checkpoint-less survey keeps the legacy single retry."""
+    from drynx_tpu.resilience import policy as rp
+    from drynx_tpu.service.store import SurveyCheckpoint
+
+    cl = _FakeCluster()
+    cl.fail_encode.add("s0")          # persistent failure
+    ck = SurveyCheckpoint(survey_id="s0")
+    cl.checkpoint_for = lambda sid: ck if sid == "s0" else None
+    srv = _warm_server(cl, pipeline=False)
+    srv.submit(_sq("s0"))
+    results = srv.drain()
+    assert isinstance(results["s0"], RuntimeError)
+    attempts = [sid for sid, _, _ in cl.exec_kwargs if sid == "s0"]
+    assert len(attempts) == 1 + rp.CHECKPOINT_MAX_RESUMES
+    assert rp.CHECKPOINT_MAX_RESUMES > rp.RESUME_MAX_RETRIES
+
+
 def test_resume_e2e_transient_refusal_equals_clean_run():
     """Real LocalCluster (proofs off): a one-shot connect refusal on dp1
     fails the first dispatch's quorum, the resume slice re-probes (the
@@ -471,6 +491,63 @@ def test_resume_e2e_transient_refusal_equals_clean_run():
     assert res.result == baseline
     # the retry saw both DPs again: full membership, nothing absent
     assert res.responders == ["dp0", "dp1"] and res.absent == []
+
+
+@pytest.mark.soak
+def test_soak_pause_revive_episode_under_load(monkeypatch):
+    """Mini pause/revive soak (the check.sh soak tier; the full harness
+    is scripts/bench_soak.py): a healing partition window cuts dp1 from
+    the client while a closed-loop LoadGen drives real surveys. The
+    checkpointed resume lane paces its re-entries across the heal
+    boundary: zero admitted surveys lost, affected surveys resumed from
+    their phase checkpoint (probe counter > 1), results equal to an
+    undisturbed run."""
+    from drynx_tpu.resilience import faults
+    from drynx_tpu.server.loadgen import LoadGen, ShapeMix
+    from drynx_tpu.service.service import LocalCluster
+
+    # resume passes must re-probe, not reuse a pre-heal verdict
+    monkeypatch.setenv("DRYNX_PROBE_TTL", "0.1")
+
+    def boot():
+        cl = LocalCluster(n_cns=1, n_dps=2, n_vns=0, seed=23,
+                          dlog_limit=1000)
+        rng = np.random.default_rng(9)
+        for _name, dp in cl.dps.items():
+            dp.data = rng.integers(0, 5, size=(3,)).astype(np.int64)
+        return cl
+
+    def run(plan):
+        faults.set_fault_plan(None)
+        cl = boot()
+        srv = SurveyServer(cl, max_batch=1, pipeline=False)
+        lg = LoadGen(srv, shapes=[ShapeMix("s", proofs=0)], seed=7,
+                     query_fn=lambda sid, shape: cl.generate_survey_query(
+                         "sum", query_min=0, query_max=9, proofs=0,
+                         survey_id=sid))
+        if plan is not None:
+            faults.set_fault_plan(plan)
+            plan.reset_epoch()
+        try:
+            rep = lg.run_closed(concurrency=1, n_total=3)
+        finally:
+            faults.set_fault_plan(None)
+        res = srv.results()
+        return rep, {s: int(r.result) for s, r in res.items()}, res
+
+    _rep, clean_sums, _ = run(None)
+
+    plan = faults.FaultPlan(seed=7)
+    plan.add(faults.FaultSpec(where="node", kind="partition", target="*",
+                              peer="dp1", after_s=0.0, heal_after_s=0.4))
+    rep, sums, res = run(plan)
+    assert rep["lost"] == 0 and rep["errors"] == 0
+    assert rep["completed"] == 3
+    assert sums == clean_sums
+    affected = [s for s, r in res.items() if r.resumes > 0]
+    assert affected, "the heal window opened at t=0: someone must resume"
+    for s in affected:
+        assert res[s].phases.get("probe", 0) >= 2  # resumed, not restarted
 
 
 # -- VN cross-flush: tampered neighbor isolation -----------------------------

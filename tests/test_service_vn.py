@@ -6,7 +6,7 @@ from drynx_tpu.crypto import elgamal as eg
 from drynx_tpu.proofs import requests as rq
 from drynx_tpu.service.proof_collection import VerifyingNode, VNGroup
 from drynx_tpu.service.skipchain import DataBlock, SkipChain
-from drynx_tpu.service.store import ProofDB
+from drynx_tpu.service.store import ProofDB, SurveyCheckpoint
 
 
 def test_proofdb_roundtrip(tmp_path):
@@ -29,6 +29,35 @@ def test_proofdb_is_native(tmp_path):
     db = ProofDB(str(tmp_path / "n.db"))
     assert db.native, "native C++ proofdb failed to build/load"
     db.close()
+
+
+def test_survey_checkpoint_roundtrip_and_reopen(tmp_path):
+    """PR 17: the phase checkpoint rides the proof log under the ckpt:
+    prefix and survives a root process restart (reopen)."""
+    db = ProofDB(str(tmp_path / "ck.db"))
+    ck = SurveyCheckpoint(survey_id="sv1")
+    ck.enter("probe")
+    ck.enter("collect")
+    ck.enter("collect")            # a healing re-entry
+    ck.responders = ["dp0", "dp2"]
+    ck.absent = ["dp1"]
+    ck.resumes = 1
+    ck.save(db)
+    # same record after a byte roundtrip
+    back = SurveyCheckpoint.from_bytes(ck.to_bytes())
+    assert back == ck
+    assert back.phase == "collect"
+    assert back.phase_entries == {"probe": 1, "collect": 2}
+    db.close()
+    # a restarted root reads it back from the reopened log
+    db2 = ProofDB(str(tmp_path / "ck.db"))
+    again = SurveyCheckpoint.load(db2, "sv1")
+    assert again == ck
+    assert SurveyCheckpoint.load(db2, "missing") is None
+    db2.close()
+    # None store: save/load degrade to no-ops (in-memory-only clusters)
+    ck.save(None)
+    assert SurveyCheckpoint.load(None, "sv1") is None
 
 
 def test_skipchain_append_and_validate(tmp_path):

@@ -55,6 +55,29 @@ def test_tree_layout_partitions_roster(n, b):
     assert d >= 1 and (n <= b) == (d == 1)
 
 
+def test_survivor_layout_reparents_dead_relay_subtree():
+    """PR 17 failover: dropping a dead interior relay from the roster
+    re-derives a valid tree over the survivors — its former descendants
+    land under live parents, the layout still partitions the index
+    space, and the result depends only on WHO survived (roster order),
+    never on probe return order."""
+    order = [f"dp{i}" for i in range(10)]
+    b = topo.tree_fanout(10)                       # 4: dp1 is interior
+    assert topo.children(1, 10, b)                 # it really has a subtree
+    alive = [n for n in order if n != "dp1"]
+    layout = topo.survivor_layout(order, set(alive))
+    assert layout == alive                         # roster order kept
+    # probe order must not matter
+    assert topo.survivor_layout(order, reversed(alive)) == layout
+    # the re-derived tree over the survivors is a full partition again
+    n2, b2 = len(layout), topo.tree_fanout(len(layout))
+    seen = [j for i in topo.roots(n2, b2)
+            for j in topo.subtree(i, n2, b2)]
+    assert sorted(seen) == list(range(n2))
+    assert topo.survivor_layout(order, set()) == []
+    assert topo.survivor_layout(order, order) == order
+
+
 def test_tree_fanout_auto_clamps_and_env(monkeypatch):
     monkeypatch.delenv(topo.ENV_FANOUT, raising=False)
     assert topo.tree_fanout(0) == 1 and topo.tree_fanout(1) == 1
