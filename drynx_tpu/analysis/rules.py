@@ -547,7 +547,20 @@ class HardcodedTimeout(Rule):
     ``tenant_quota=8`` decides when a tenant starts seeing typed
     rejections exactly like a bare timeout decides when a caller gives
     up — the defaults live in policy.py (VERIFY_WORKERS, TENANT_QUOTA,
-    SHED_FRACTION, SHED_RETRY_MIN_S/MAX_S)."""
+    SHED_FRACTION, SHED_RETRY_MIN_S/MAX_S).
+
+    Streaming surveys (PR 18) added the window/pane/epsilon family:
+    pane width, window span, per-advance privacy spend and slide pacing
+    (pane_width=/window_panes=/epsilon_budget=/epsilon_per_advance=/
+    slide_pacing=), surfaced as the DRYNX_PANE_WIDTH /
+    DRYNX_STREAM_WINDOW / DRYNX_EPSILON_BUDGET /
+    DRYNX_EPSILON_PER_ADVANCE / DRYNX_SLIDE_PACING env knobs. A literal
+    ``epsilon_budget=1.0`` is a PRIVACY bound — a fork of that default
+    away from policy is strictly worse than an unauditable timeout —
+    and a literal ``pane_width=4096`` silently re-shapes every proof
+    blob a stream caches; the defaults live in policy.py (PANE_WIDTH,
+    STREAM_WINDOW_PANES, EPSILON_BUDGET, EPSILON_PER_ADVANCE,
+    SLIDE_PACING_S)."""
 
     id = "hardcoded-timeout"
     summary = ("bare numeric timeout/retry/worker-pool literal outside "
@@ -569,7 +582,16 @@ class HardcodedTimeout(Rule):
                 # NB: substring "shed" would also match "finished"
                 or n == "shed" or n.startswith("shed_")
                 or n.endswith("_shed") or "shed_fraction" in n
-                or "retry_after" in n)
+                or "retry_after" in n
+                # streaming knobs: substring matches so the env-var forms
+                # (DRYNX_PANE_WIDTH, DRYNX_STREAM_WINDOW, ...) fire in
+                # .get() fallbacks too; bare "epsilon" stays unmatched —
+                # it is a common math variable name
+                or "pane_width" in n
+                or "window_panes" in n or "stream_window" in n
+                or "epsilon_budget" in n or "epsilon_per_advance" in n
+                or n.endswith("_epsilon")
+                or "slide_pacing" in n)
 
     @staticmethod
     def _nonzero_num(node: ast.AST) -> bool:

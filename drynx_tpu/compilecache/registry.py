@@ -87,6 +87,19 @@ class Profile:
                             # 0 (default) = star dispatch, no extra
                             # programs, so star registries stay a subset
                             # of tree ones (test_precompile.py pattern).
+    n_pane: int = 0         # streaming-survey window width in PANES
+                            # (service/streaming.StreamEngine): > 1 adds
+                            # the pane-delta program set — the raw
+                            # ct_add/ct_sub jits of the window delta
+                            # chain at the (V,) window shape
+                            # (_pane_specs) plus the first advance's
+                            # pane-stack fold, bucketed ct_add at the
+                            # halving widths of n_pane (_pane_schemas).
+                            # 0 (default) = one-shot survey, no extra
+                            # programs, so one-shot registries stay a
+                            # subset of streaming ones
+                            # (test_precompile.py enforces both
+                            # directions, mirroring n_fold).
 
 
 BENCH = Profile()
@@ -104,7 +117,8 @@ class ProgramSpec:
 
     name: str               # e.g. "bucketed:pair@2048"
     op: str                 # registry family key (BUCKETED_OPS name, ...)
-    kind: str               # "bucketed" | "pallas" | "fused" | "pool"
+    kind: str               # "bucketed" | "pallas" | "fused" | "pool" |
+                            # "wire" | "pane"
     phase: str              # survey phase that dispatches it (doc only)
     lower: Callable[[], object]
     dispatched: Callable[[], bool]
@@ -416,6 +430,28 @@ def _fold_schemas(p: Profile) -> list:
          [(lambda p, bb=bb: bb) for bb in batches], "TreeFold", "device"),
         ("g1_normalize", lambda p, b: (_g1(b),),
          [lambda p: 2 * p.n_values], "TreeFold", "g1"),
+    ]
+
+
+def _pane_schemas(p: Profile) -> list:
+    """The window-fold program set of a streaming survey's FIRST advance
+    (service/streaming.StreamEngine): before the delta chain takes over,
+    the initial window aggregate folds the (n_pane, V) pane stack with
+    topology.fold_cts — bucketed ct_add at the halving widths of n_pane
+    (the canon g1_normalize at 2*V is already a base program). Empty when
+    n_pane <= 1, so one-shot registries stay a subset of streaming ones
+    (tests/test_precompile.py enforces both directions)."""
+    if p.n_pane <= 1:
+        return []
+    widths = []
+    n = p.n_pane
+    while n > 1:
+        widths.append(n // 2)        # batch of one tree_reduce_add level
+        n = n // 2 + (n % 2)
+    batches = sorted({w * p.n_values for w in widths})
+    return [
+        ("ct_add", lambda p, b: (_ct(b), _ct(b)),
+         [(lambda p, bb=bb: bb) for bb in batches], "PaneFold", "device"),
     ]
 
 
@@ -733,6 +769,39 @@ def _pool_specs(p: Profile) -> list:
     return specs
 
 
+def _pane_specs(p: Profile) -> list:
+    """The pane-delta program set of a streaming survey
+    (service/streaming.StreamEngine.advance): every steady-state window
+    slide dispatches the RAW ciphertext jits ``eg.ct_add`` / ``eg.ct_sub``
+    at the standing (V, 2, 3, NL) window-aggregate shape — one call per
+    added / expired pane. Raw, not bucketed: the delta chain runs
+    elementwise on the window tensor, so the jits trace at exactly that
+    shape (the bucketed ct_add family only covers the batch-flattened
+    widths). Empty when n_pane <= 1, so one-shot registries stay a
+    subset of streaming ones (tests/test_precompile.py enforces both
+    directions)."""
+    if p.n_pane <= 1:
+        return []
+    V = p.n_values
+
+    def at(nm):
+        def go(do="lower"):
+            from ..crypto import elgamal as eg
+
+            fn = getattr(eg, nm)
+            args = (_ct(V), _ct(V))
+            return fn(*args) if do == "call" else fn.lower(*args)
+        return go
+
+    specs = []
+    for nm in ("ct_add", "ct_sub"):
+        th = at(nm)
+        specs.append(ProgramSpec(
+            f"pane:{nm}@{V}", nm, "pane", "PaneDelta", th,
+            lambda: True, lambda th=th: th("call"), family="device"))
+    return specs
+
+
 # canonical flat width the wire widen programs lower at: the program is
 # elementwise so any width certifies the pipeline; 4096 matches the pool
 # slab width (the largest steady-state wire tensor)
@@ -780,7 +849,7 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
     for op, args_fn, batches, phase, gate in (
             _B_SCHEMAS + _shard_schemas(profile)
             + _queue_schemas(profile) + _bucket_schemas(profile)
-            + _fold_schemas(profile)):
+            + _fold_schemas(profile) + _pane_schemas(profile)):
         w = B.BUCKETED_OPS.get(op)
         for bexpr in batches:
             batch = int(bexpr(profile))
@@ -807,7 +876,8 @@ def build_registry(profile: Profile = BENCH) -> list[ProgramSpec]:
             specs[name] = ProgramSpec(name, op, "bucketed", phase, lower,
                                       _GATES[gate], call, family=gate)
     for s in (_pallas_specs(profile) + _fused_specs(profile)
-              + _pool_specs(profile) + _wire_specs(profile)):
+              + _pool_specs(profile) + _pane_specs(profile)
+              + _wire_specs(profile)):
         specs[s.name] = s
     return list(specs.values())
 

@@ -386,6 +386,9 @@ def decode(op: str, dec: DecryptedVector, query_min: int = 0,
         return [query_min + i for i in np.flatnonzero(nz)]
     if op == "inter":
         return [query_min + i for i in np.flatnonzero(~nz)]
+    base, _, arg = op.partition(":")
+    if base in DECODE_MODES:
+        return _decode_histogram_mode(base, arg, v, query_min)
     if op == "lin_reg":
         return _decode_linreg(v, dims)
     if op == "r2":
@@ -441,10 +444,58 @@ def _decode_linreg(v: np.ndarray, d: int):
     return np.asarray([float(A[r][m]) for r in range(m)])
 
 
+def _decode_histogram_mode(mode: str, arg: str, counts: np.ndarray,
+                           query_min: int):
+    """Order-statistic decode modes over the ``frequency_count`` grid
+    (PR 18 streaming decode modes). The aggregated plaintext is already
+    the count-per-grid-value histogram, so quantiles, the median and
+    top-k are pure host-side walks over it — no new encoding, no new
+    ciphertext layout, and (load-bearing for streaming) they stay exact
+    under pane addition/subtraction because the underlying vector does.
+
+    Parameterized via the op string — ``"quantile:0.9"`` / ``"top_k:3"``
+    (bare ``"quantile"`` means the median; bare ``"top_k"`` means k=1) —
+    which keeps the ``decode(op, dec, ...)`` dispatch signature intact.
+
+    Sparse-grid sentinels mirror the decode_grouped ambiguity table: an
+    all-zero histogram has no q-th value (``None``, like ``min``'s empty
+    OR bits) and no top values (``[]``, like an empty ``union``) — count
+    zeros are *absence*, not observations of zero.
+    """
+    c = counts.astype(np.int64)
+    total = int(c.sum())
+    if mode == "top_k":
+        k = int(arg) if arg else 1
+        if k <= 0:
+            raise ValueError(f"top_k needs a positive k, got {k}")
+        idx = np.flatnonzero(c > 0)
+        # count desc, then grid value asc: a deterministic total order,
+        # so streaming advances over identical windows return identical
+        # lists regardless of fold grouping
+        order = sorted(idx, key=lambda i: (-int(c[i]), int(i)))
+        return [query_min + int(i) for i in order[:k]]
+    q = 0.5 if mode == "median" else (float(arg) if arg else 0.5)
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"quantile q must be in (0, 1], got {q}")
+    if total == 0:
+        return None
+    # lower quantile (inverse CDF): smallest grid value whose cumulative
+    # count reaches rank ceil(q * total); q=0.5 is the lower median
+    rank = int(np.ceil(q * total))
+    cum = np.cumsum(c)
+    return query_min + int(np.searchsorted(cum, rank))
+
+
 OPS = ["sum", "mean", "variance", "cosim", "bool_OR", "bool_AND", "min",
        "max", "frequency_count", "union", "inter", "lin_reg", "r2"]
 
-__all__ = ["OPS", "GRID_OPS", "grid_buckets", "DecryptedVector",
+# Decode-only modes (no encoder entry): they read a frequency_count
+# window, so ``encode_clear("frequency_count", ...)`` + ``decode("median",
+# ...)`` is the pairing — StreamEngine's ``decode_mode=``.
+DECODE_MODES = ("quantile", "median", "top_k")
+
+__all__ = ["OPS", "GRID_OPS", "DECODE_MODES", "grid_buckets",
+           "DecryptedVector",
            "encode_clear", "decode",
            "output_size", "group_grid", "encode_clear_grouped",
            "decode_grouped", "encode_clear_tiles", "encode_clear_tiled"]

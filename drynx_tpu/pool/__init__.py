@@ -17,6 +17,7 @@ import os
 
 from .store import (CryptoPool, DoubleConsumption, InsufficientBalance,
                     PoolError, key_digest)
+from .epsilon import EpsilonExhausted, EpsilonLedger
 from . import replenish
 
 _ACTIVE: CryptoPool | None = None
@@ -46,4 +47,4 @@ def active_pool() -> CryptoPool | None:
 
 __all__ = ["CryptoPool", "PoolError", "DoubleConsumption",
            "InsufficientBalance", "key_digest", "replenish",
-           "activate", "active_pool"]
+           "activate", "active_pool", "EpsilonLedger", "EpsilonExhausted"]

@@ -58,6 +58,11 @@ def main(argv=None) -> int:
                     help="DRO noise-list size of a diffp survey; > 0 adds "
                          "the pool/slab program set (precompute refill + "
                          "shuffle) at dro.slab_widths chunk widths")
+    ap.add_argument("--panes", type=int, default=0,
+                    help="streaming-survey window width in panes "
+                         "(service/streaming); > 1 adds the pane-delta "
+                         "program set: raw ct_add/ct_sub at the window "
+                         "shape plus the first advance's pane-stack fold")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -80,7 +85,8 @@ def main(argv=None) -> int:
                          l=args.range_l, dlog_limit=args.dlog_limit,
                          n_shards=n_shards, n_queue=max(1, args.queue),
                          n_buckets=max(0, args.buckets),
-                         n_noise=max(0, args.noise))
+                         n_noise=max(0, args.noise),
+                         n_pane=max(0, args.panes))
 
     if args.list:
         specs = cc.build_registry(profile)

@@ -55,12 +55,26 @@ def _challenge(r_bytes: bytes, pub_bytes: bytes, msg: bytes) -> int:
     return int.from_bytes(h.digest(), "big") % params.N
 
 
+# secret -> affine public point. A host g1_mul is ~100 ms of pure-Python
+# field inversions; a long-lived sender (stream engines sign one envelope
+# per sealed pane) would otherwise pay it on every signature.
+_PUB_CACHE: dict[int, tuple] = {}
+
+
+def _pub_for(secret: int):
+    pub = _PUB_CACHE.get(secret)
+    if pub is None:
+        pub = _PUB_CACHE[secret] = refimpl.g1_mul(refimpl.G1, secret)
+    return pub
+
+
 def sign(secret: int, msg: bytes, k: int | None = None) -> Signature:
     """Schnorr-sign msg with secret scalar. Host-side (rare path)."""
     if k is None:
         k = secrets.randbelow(params.N - 1) + 1
     R = refimpl.g1_mul(refimpl.G1, k)
-    pub = refimpl.g1_mul(refimpl.G1, secret)
+    # the public key is public by construction (dlog hides the scalar)
+    pub = _pub_for(secret)  # drynx: declassify[secret]
     r_bytes = _point_bytes_host(R)
     c = _challenge(r_bytes, _point_bytes_host(pub), msg)
     # the Schnorr response is public by construction: c is bound to the

@@ -151,6 +151,30 @@ CHECKPOINT_MAX_RESUMES = 3
 # burning a bounded retry on a still-open partition.
 RESUME_BACKOFF_S = 0.5
 
+# -- streaming-surveys knobs (PR 18) -----------------------------------------
+# Pane width: rows per immutable pane in the streaming engine
+# (service/streaming.py). A pane is the unit of encode/encrypt/range-prove
+# amortization — larger panes amortize proof creation over more rows,
+# smaller panes give finer window slides. DRYNX_PANE_WIDTH overrides.
+PANE_WIDTH = 4096
+# Default sliding-window length in panes (window = STREAM_WINDOW_PANES
+# most recent sealed panes). DRYNX_STREAM_WINDOW overrides.
+STREAM_WINDOW_PANES = 8
+# Per-(DP, cohort) epsilon budget the accountant enforces (pool/epsilon.py)
+# before any advance runs: once spent-to-date + the advance's epsilon would
+# exceed this, admission raises EpsilonExhausted. DRYNX_EPSILON_BUDGET
+# overrides.
+EPSILON_BUDGET = 1.0
+# Epsilon one window advance charges against each responding DP's budget
+# (the accountant's unit of consumption under basic composition).
+# DRYNX_EPSILON_PER_ADVANCE overrides.
+EPSILON_PER_ADVANCE = 0.01
+# Slide pacing: minimum seconds between window advances the scheduler's
+# fast lane enforces per stream, so a hot querier can't drain a cohort's
+# epsilon budget in one burst. 0 disables pacing. DRYNX_SLIDE_PACING
+# overrides.
+SLIDE_PACING_S = 0.0
+
 # -- idempotency table ------------------------------------------------------
 # Read-only or set-once-overwrite handlers: re-execution is harmless.
 IDEMPOTENT_MTYPES = frozenset({
@@ -265,4 +289,5 @@ __all__ = ["RetryPolicy", "DEFAULT_POLICY", "is_idempotent",
            "SHED_RETRY_MIN_S", "SHED_RETRY_MAX_S", "RATE_WINDOW_EVENTS",
            "REFILL_HORIZON_S", "REFILL_MAX_SLABS_STEP",
            "RESUME_MAX_RETRIES", "PROBE_TTL_S", "CHECKPOINT_MAX_RESUMES",
-           "RESUME_BACKOFF_S"]
+           "RESUME_BACKOFF_S", "PANE_WIDTH", "STREAM_WINDOW_PANES",
+           "EPSILON_BUDGET", "EPSILON_PER_ADVANCE", "SLIDE_PACING_S"]

@@ -24,6 +24,14 @@ from ..resilience.policy import named_lock
 from ..encoding import stats as st
 from ..parallel import proof_plane as plane
 
+# Streaming admission (PR 18): an exhausted per-(DP, cohort) epsilon
+# budget is an admission-time rejection exactly like QueueFull — typed,
+# raised at advance_stream() submit, before anything queues or touches a
+# device. The accountant lives with the other durable ledgers
+# (pool/epsilon.py); re-exported here because this is where callers
+# catch it.
+from ..pool import EpsilonExhausted
+
 
 class AdmissionError(Exception):
     """Base class for admission rejections."""
@@ -182,4 +190,4 @@ class AdmissionController:
 
 
 __all__ = ["Admission", "AdmissionController", "AdmissionError",
-           "QueueFull", "QuotaExceeded", "Overloaded"]
+           "QueueFull", "QuotaExceeded", "Overloaded", "EpsilonExhausted"]

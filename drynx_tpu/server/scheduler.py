@@ -29,6 +29,17 @@ overlaps survey N's VN verification (worker threads). PhaseTimers
 absolute spans (``Pipeline.encode.<sid>`` / ``Pipeline.verify.<sid>``)
 record the overlap; ``pipeline_overlap`` integrates it.
 
+Streaming (PR 18): a registered ``StreamEngine`` gets an *advance* fast
+lane that bypasses admission re-triage entirely. ``open_stream`` triages
+and prewarms the stream's prototype shape ONCE; ``advance_stream`` then
+charges the per-DP epsilon budget at submit (typed
+``EpsilonExhausted`` — the streaming analogue of QueueFull, rejected
+before anything queues) and appends to ``_advance``, which ``drain``
+services BEFORE every other lane. The advance itself runs on the drain
+thread (it traces and dispatches under the proof-device lock — the same
+threading contract as execute_survey), so a stream's slides interleave
+with, but never re-queue behind, the one-shot survey load.
+
 Fairness (PR 12): the fast lane is one deque PER TENANT, served by
 deficit round-robin — each visit credits a tenant ``max_batch × weight``
 quantum and pops at most that many shape-equal entries, so a hot tenant
@@ -70,6 +81,18 @@ class _Entry:
     # post-probe live responder set carried into the retry
     retries: int = 0
     responders: tuple | None = None
+
+
+@dataclasses.dataclass
+class _AdvanceEntry:
+    """One queued window advance for a registered stream. Carries the
+    engine itself (not a survey query): the advance's survey id is only
+    minted when the window slides, so results are recorded under the
+    ``ticket`` handed back by advance_stream()."""
+
+    engine: object
+    ticket: str
+    tenant: str = "default"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -156,6 +179,15 @@ class SurveyServer:
         # overlaps the verify workers (the pipeline gaps).
         self._refill: collections.deque = collections.deque()
         self.refill_slabs = 0
+        # streaming advance lane (PR 18): registered engines and their
+        # queued advances. Advances bypass the admission gates (the
+        # stream's shape was triaged once at open_stream; epsilon is
+        # charged at submit) and are served before every other lane, so
+        # they never count toward the one-shot depth/quota bounds.
+        self.streams: dict[str, object] = {}
+        self._advance: collections.deque = collections.deque()
+        self._advance_seq = 0
+        self._stream_last_t: dict[str, float] = {}
         self._results: dict[str, object] = {}
         self._errors: dict[str, Exception] = {}
         self._admissions: dict[str, adm.Admission] = {}
@@ -215,6 +247,52 @@ class SurveyServer:
 
     def admission_of(self, survey_id: str) -> adm.Admission | None:
         return self._admissions.get(survey_id)
+
+    # -- streaming fast lane (PR 18) ---------------------------------------
+
+    def open_stream(self, engine=None, prewarm: bool = True, **kwargs):
+        """Register a streaming engine with this scheduler and return it.
+
+        Either pass a built ``StreamEngine`` or kwargs to construct one
+        over this server's cluster. Triage happens ONCE here: the
+        stream's prototype query is driven through the precompile pass on
+        the calling thread (``prewarm=True``), so every later
+        ``advance_stream`` bypasses admission re-triage entirely — the
+        shape cannot go cold between slides."""
+        if engine is None:
+            from ..service.streaming import StreamEngine
+
+            engine = StreamEngine(self.cluster, **kwargs)
+        if prewarm and engine.proofs_on:
+            self.prewarm(engine.sq_proto)
+        with self._lock:
+            self.streams[engine.stream_id] = engine
+        return engine
+
+    def advance_stream(self, stream_id: str, rows_by_dp: dict | None = None,
+                       tenant: str = "default") -> str:
+        """Feed ``rows_by_dp`` (optional) and queue one window advance on
+        the advance fast lane; returns a ticket under which results()
+        reports the :class:`~..service.streaming.StreamAdvance`.
+
+        The per-DP epsilon budget is charged HERE, at submit: an
+        exhausted (DP, cohort) budget raises the typed
+        ``adm.EpsilonExhausted`` before anything queues — the streaming
+        admission gate, checked like QueueFull but against a privacy
+        ledger instead of a depth bound. The queued advance then runs
+        ``precharged`` (the engine never double-charges)."""
+        engine = self.streams.get(stream_id)
+        if engine is None:
+            raise KeyError(f"unknown stream {stream_id!r}; open_stream first")
+        if rows_by_dp:
+            engine.feed(rows_by_dp)
+        engine.charge_epsilon()
+        with self._lock:
+            self._advance_seq += 1
+            ticket = f"{stream_id}#a{self._advance_seq}"
+            self._advance.append(_AdvanceEntry(engine=engine, ticket=ticket,
+                                               tenant=tenant))
+        return ticket
 
     def _depth_locked(self) -> int:
         return (sum(len(q) for q in self._fast.values())
@@ -358,11 +436,44 @@ class SurveyServer:
             self._admissions[sid] = entry.admission
             self._route_locked(entry)
 
+    # -- advance lane (drain thread only) ----------------------------------
+
+    def _advance_step(self, adv: _AdvanceEntry) -> None:
+        """Run one queued window advance on the drain thread (the
+        engine's delta fold / proof delivery / key-switch all trace and
+        dispatch under the proof-device lock — the same threading
+        contract as execute_survey). Slide pacing, when configured
+        (DRYNX_SLIDE_PACING / rp.SLIDE_PACING_S), enforces a minimum
+        inter-advance gap per stream here rather than at submit, so a
+        caller may queue a burst and still release at the paced rate."""
+        eng = adv.engine
+        pace = _env_float("DRYNX_SLIDE_PACING", rp.SLIDE_PACING_S)
+        if pace > 0.0:
+            last = self._stream_last_t.get(eng.stream_id)
+            if last is not None:
+                wait = pace - (time.monotonic() - last)
+                if wait > 0.0:
+                    time.sleep(wait)
+        t0 = time.perf_counter()
+        try:
+            res = eng.advance(precharged=True)
+        except Exception as exc:
+            log.warn(f"server: stream advance {adv.ticket} failed: {exc}")
+            self._record_error(adv.ticket, exc)
+        else:
+            self._record_result(adv.ticket, res)
+        finally:
+            self._stream_last_t[eng.stream_id] = time.monotonic()
+            self.timers.span(f"Advance.{adv.ticket}",
+                             t0, time.perf_counter())
+
     # -- drain loop --------------------------------------------------------
 
     def _drain_step(self) -> bool:
         """One scheduling decision on the calling thread; False when all
-        lanes are empty. Fast work first, then compile (it unblocks
+        lanes are empty. Stream advances first (they pre-paid admission
+        at open_stream/advance_stream and their deltas are latency-
+        sensitive), then fast work, then compile (it unblocks
         encodes that feed the verify pipeline), then refill — the refill
         lane is pure gap work: slab deposits overlap whatever the verify
         workers are grinding, and nothing downstream waits on them until
@@ -370,8 +481,11 @@ class SurveyServer:
         group = None
         entry = None
         rentry = None
+        adv = None
         with self._lock:
-            if any(len(q) for q in self._fast.values()):
+            if self._advance:
+                adv = self._advance.popleft()
+            elif any(len(q) for q in self._fast.values()):
                 group = self._pop_group_locked()
             elif self._compile:
                 entry = self._compile.popleft()
@@ -379,7 +493,9 @@ class SurveyServer:
                 rentry = self._refill.popleft()
             else:
                 return False
-        if group is not None:
+        if adv is not None:
+            self._advance_step(adv)
+        elif group is not None:
             self._run_group(group)
         elif rentry is not None:
             self._refill_step(rentry)

@@ -107,6 +107,12 @@ class Survey:
         self.sq = sq
         self.timers = PhaseTimers()
         self.proof_threads: list[threading.Thread] = []
+        # streaming surveys (PR 18): a per-advance survey registered by a
+        # StreamEngine carries its engine here so the VN-side range
+        # verifier routes pane blobs through the engine's cross-advance
+        # digest memo (service/streaming.py) instead of re-verifying a
+        # cached pane every slide. None for ordinary one-shot surveys.
+        self.stream = None
 
 
 class LocalCluster:
@@ -227,10 +233,17 @@ class LocalCluster:
     # ------------------------------------------------------------------
     def _verify_fns(self):
         def vrange(data: bytes, survey_id: str) -> bool:
-            lst = rproof.RangeProofList.from_bytes(data)
             survey = self.surveys.get(survey_id)
             if survey is None:
                 return False
+            if survey.stream is not None:
+                # streaming advance: pane blobs are immutable and recur
+                # across window slides under fresh per-advance survey ids,
+                # which the VerifyCache's sid-scoped key cannot exploit —
+                # the engine's digest-keyed memo verifies each pane ONCE
+                # for the stream's whole lifetime (service/streaming.py)
+                return survey.stream.verify_pane_blob(data)
+            lst = rproof.RangeProofList.from_bytes(data)
             expected = self._ranges_per_value(survey.sq.query)
             sigs_pub_by_u = {
                 u: [s.public for s in sigs]

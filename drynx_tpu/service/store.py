@@ -168,6 +168,18 @@ class ProofDB:
 
 _CKPT_PREFIX = b"ckpt:"
 
+# Streaming-survey pane cache (PR 18): sealed panes' range-proof blobs
+# persist under the same append-only log as proofs and checkpoints, in a
+# key prefix neither of those paths uses. A pane is immutable, so its
+# cached blob is reused byte-identically by every window slide containing
+# it — the store is the reuse, not just durability.
+_PANE_PREFIX = b"pane:"
+
+
+def pane_key(stream_id: str, pane_id: int, dp_name: str) -> bytes:
+    """ProofDB key for one (stream, pane, DP) range-proof blob."""
+    return _PANE_PREFIX + f"{stream_id}/{int(pane_id)}/{dp_name}".encode()
+
 
 @dataclasses.dataclass
 class SurveyCheckpoint:
@@ -225,4 +237,4 @@ class SurveyCheckpoint:
         return cls.from_bytes(raw)
 
 
-__all__ = ["ProofDB", "SurveyCheckpoint"]
+__all__ = ["ProofDB", "SurveyCheckpoint", "pane_key"]

@@ -78,4 +78,10 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m soak \
     tests/test_server.py
 python scripts/bench_soak.py --smoke > /dev/null
 
+echo "== stream smoke (pane-delta window advance over one supervised child:"
+echo "== delta == from-scratch == ground truth, restart byte-identity,"
+echo "== O(delta) proof work; + the epsilon-ledger exhaustion/replay/race"
+echo "== gates in a second child) =="
+python scripts/bench_stream.py --smoke > /dev/null
+
 echo "check.sh: all green"

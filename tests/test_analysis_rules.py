@@ -403,6 +403,48 @@ def test_hardcoded_timeout_allows_policy_backed_admission_knobs():
     assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
 
 
+def test_hardcoded_timeout_covers_streaming_knobs():
+    src = """
+        import os
+
+        def stream(cluster, pane_width=4096, window_panes=8):
+            adv = advance(epsilon_per_advance=0.01)
+            pace(slide_pacing=2.0)
+            b = float(os.environ.get("DRYNX_EPSILON_BUDGET", 1.0))
+            w = int(os.environ.get("DRYNX_STREAM_WINDOW", 8))
+            ledger = open_ledger(epsilon_budget=1.0)
+    """
+    found = run(src, relpath=SERVICE, rule="hardcoded-timeout")
+    assert len(found) == 7
+    texts = " ".join(f.message for f in found)
+    assert "pane_width=4096" in texts and "window_panes=8" in texts
+    assert "epsilon_per_advance=0.01" in texts
+    assert "slide_pacing=2.0" in texts
+    assert ".get('DRYNX_EPSILON_BUDGET', 1.0)" in texts
+    assert "epsilon_budget=1.0" in texts
+
+
+def test_hardcoded_timeout_allows_policy_backed_streaming_knobs():
+    # the streaming.py idiom: None defaults resolved through string-typed
+    # env reads and policy constants; bare "epsilon" is a math variable
+    # name, not a knob, and must not match
+    src = """
+        import os
+        from drynx_tpu.resilience import policy as rp
+
+        def stream(cluster, pane_width=None, window_panes=None,
+                   epsilon_per_advance=None):
+            raw = os.environ.get("DRYNX_PANE_WIDTH", "").strip()
+            w = int(raw) if raw else rp.PANE_WIDTH
+            eng = engine(pane_width=rp.PANE_WIDTH,
+                         window_panes=rp.STREAM_WINDOW_PANES,
+                         epsilon_per_advance=rp.EPSILON_PER_ADVANCE)
+            pace(slide_pacing=rp.SLIDE_PACING_S)
+            laplace(epsilon=2.0)
+    """
+    assert run(src, relpath=SERVICE, rule="hardcoded-timeout") == []
+
+
 # -- suppression + baseline mechanics ---------------------------------------
 
 def test_noqa_suppresses_named_rule_only():

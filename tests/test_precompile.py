@@ -48,7 +48,7 @@ def test_registry_names_unique_and_thunks_wellformed(registry):
         assert callable(s.lower) and callable(s.dispatched), s.name
         assert s.call is None or callable(s.call), s.name
         assert s.kind in ("bucketed", "pallas", "fused", "pool",
-                          "wire"), s.name
+                          "wire", "pane"), s.name
 
 
 def test_registry_scales_with_profile():
@@ -292,3 +292,49 @@ def test_cli_list_noise_includes_pool_programs(capsys):
     assert cli.main(["--list"]) == 0
     out = capsys.readouterr().out
     assert "DROPool" not in out
+
+
+def test_registry_pane_program_set():
+    """Profile.n_pane > 1 must add the streaming pane-delta programs —
+    the RAW ct_add/ct_sub jits at the (V,) window-aggregate shape plus
+    the first advance's bucketed pane-stack fold — and must only ever
+    ADD programs: the one-shot registry stays a strict subset, mirroring
+    the n_fold / n_noise contracts."""
+    base = cc.BENCH
+    paned = cc.build_registry(dataclasses_replace(base, n_pane=16))
+    base_names = {s.name for s in cc.build_registry(base)}
+    paned_names = {s.name for s in paned}
+    assert base_names <= paned_names
+    extra = [s for s in paned if s.name not in base_names]
+    assert extra, "n_pane=16 must add pane-delta programs"
+    phases = {s.phase for s in extra}
+    assert phases <= {"PaneDelta", "PaneFold"}
+    assert "PaneDelta" in phases
+    # the raw delta jits at the window shape, both directions
+    assert f"pane:ct_add@{base.n_values}" in paned_names
+    assert f"pane:ct_sub@{base.n_values}" in paned_names
+    # pane programs always dispatch (plain device jits, no backend gate)
+    assert all(s.dispatched() for s in extra if s.kind == "pane")
+
+
+def test_registry_n_pane_zero_and_one_are_identity():
+    """n_pane in {0, 1} means no delta chain (a 1-pane window re-folds
+    from scratch), so the registry must be exactly the one-shot set."""
+    base = cc.BENCH
+    base_names = {s.name for s in cc.build_registry(base)}
+    for n in (0, 1):
+        same = cc.build_registry(dataclasses_replace(base, n_pane=n))
+        assert {s.name for s in same} == base_names, n
+
+
+def test_cli_list_panes_includes_pane_programs(capsys):
+    from drynx_tpu import precompile as cli
+
+    assert cli.main(["--list", "--panes", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "pane:ct_sub@9" in out
+    assert "PaneDelta" in out
+    # no streaming axis -> no pane programs
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "PaneDelta" not in out

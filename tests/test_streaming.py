@@ -1,0 +1,394 @@
+"""Streaming surveys (PR 18, drynx_tpu/service/streaming.py).
+
+Four properties carry the design and each gets direct coverage here:
+
+  * **Epsilon single-spend** — the per-(DP, cohort) budget must admit a
+    charge exactly once whatever the interleaving: across racing threads
+    AND across a process restart (the fsync'd journal replays as spent).
+    Mirrors the DRO slab double-consumption pair in test_pool.py — same
+    privacy argument, different ledger.
+  * **Decode modes** — quantile / median / top_k are pure host-side
+    walks over the frequency_count histogram, with the sparse-grid
+    sentinel table (empty window -> None / []) mirroring
+    decode_grouped's ambiguity rules.
+  * **Expired-pane subtraction exactness** — ct_sub of an expired pane
+    followed by canon_points yields BYTES equal to a from-scratch fold
+    of the remaining window (abelian cancellation mod p; the streaming
+    extension of test_topology.py's fold-associativity contract).
+  * **Delta == from-scratch through the full pipeline** (slow tier) —
+    at 1/2/4-pane slides a delta advance and a fresh engine re-fed the
+    same rows produce identical survey ids, results, decrypted bytes
+    and VN proof transcripts; pane proof blobs persisted in a ProofDB
+    are reused byte-identically by a restarted engine with zero new
+    proof creations.
+"""
+import threading
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from drynx_tpu import pool as pool_mod  # noqa: E402
+from drynx_tpu.crypto import elgamal as eg  # noqa: E402
+from drynx_tpu.encoding import stats as st  # noqa: E402
+from drynx_tpu.service import topology as topo  # noqa: E402
+from drynx_tpu.service.store import ProofDB, pane_key  # noqa: E402
+
+
+# -- epsilon ledger: single-spend across threads and restarts ---------------
+
+def test_epsilon_exact_budget_and_typed_rejection(tmp_path):
+    led = pool_mod.EpsilonLedger(str(tmp_path), budget=1.0)
+    for _ in range(4):
+        led.charge("dp1", "cohortA", 0.25)
+    # 4 x 0.25 lands exactly at the budget (float drift absorbed by the
+    # ledger's slack) -- admitted; the 5th is the typed rejection
+    assert led.spent("dp1", "cohortA") == pytest.approx(1.0)
+    assert led.remaining("dp1", "cohortA") == pytest.approx(0.0)
+    with pytest.raises(pool_mod.EpsilonExhausted):
+        led.charge("dp1", "cohortA", 0.25)
+    assert isinstance(pool_mod.EpsilonExhausted("x"), pool_mod.PoolError)
+    # budgets are per (dp, cohort): other identities are untouched
+    led.charge("dp2", "cohortA", 0.25)
+    led.charge("dp1", "cohortB", 0.25)
+    assert led.counters["charges"] == 6
+    assert led.counters["rejections"] == 1
+
+
+def test_epsilon_negative_charge_rejected(tmp_path):
+    led = pool_mod.EpsilonLedger(str(tmp_path), budget=1.0)
+    with pytest.raises(pool_mod.PoolError):
+        led.charge("dp1", "c", -0.1)
+    assert led.spent("dp1", "c") == 0.0
+
+
+def test_epsilon_double_spend_across_threads(tmp_path):
+    """8 threads race one remaining 0.1 of budget: exactly one wins
+    (test_pool.py's slab double-consumption barrier, ported)."""
+    led = pool_mod.EpsilonLedger(str(tmp_path), budget=0.1)
+    barrier = threading.Barrier(8)
+    wins, raises = [], []
+
+    def racer():
+        barrier.wait()
+        try:
+            led.charge("dp1", "cohortA", 0.1)
+            wins.append(1)
+        except pool_mod.EpsilonExhausted:
+            raises.append(1)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1 and len(raises) == 7
+    assert led.spent("dp1", "cohortA") == pytest.approx(0.1)
+
+
+def test_epsilon_double_spend_across_restart(tmp_path):
+    """A replayed journal keeps exhausted budgets exhausted, and a
+    REJECTED charge journals nothing (restart does not resurrect it)."""
+    led = pool_mod.EpsilonLedger(str(tmp_path), budget=0.5)
+    led.charge("dp1", "cohortA", 0.5)
+    with pytest.raises(pool_mod.EpsilonExhausted):
+        led.charge("dp1", "cohortA", 0.5)
+    # simulated process restart: fresh accountant over the same root
+    led2 = pool_mod.EpsilonLedger(str(tmp_path), budget=0.5)
+    assert led2.spent("dp1", "cohortA") == pytest.approx(0.5)
+    with pytest.raises(pool_mod.EpsilonExhausted):
+        led2.charge("dp1", "cohortA", 0.5)
+    assert led2.check("dp1", "cohortA", 0.5) is False
+    assert led2.check("dp2", "cohortA", 0.5) is True
+
+
+def test_epsilon_ledger_survives_torn_tail(tmp_path):
+    """A crash mid-append leaves a torn JSON tail; replay drops it and
+    every complete event before it stays spent."""
+    led = pool_mod.EpsilonLedger(str(tmp_path), budget=1.0)
+    led.charge("dp1", "cohortA", 0.5)
+    with open(led._ledger_path, "a", encoding="utf-8") as f:
+        f.write('{"ev": "consume", "dp": "dp1", "coh')  # torn mid-write
+    led2 = pool_mod.EpsilonLedger(str(tmp_path), budget=1.0)
+    assert led2.spent("dp1", "cohortA") == pytest.approx(0.5)
+    led2.charge("dp1", "cohortA", 0.5)  # the torn event never counted
+    with pytest.raises(pool_mod.EpsilonExhausted):
+        led2.charge("dp1", "cohortA", 0.01)
+
+
+# -- decode modes over the frequency grid -----------------------------------
+
+def _dv(counts):
+    c = np.asarray(counts, dtype=np.int64)
+    return st.DecryptedVector(values=c, found=np.ones_like(c, dtype=bool),
+                              is_zero=(c == 0))
+
+
+def test_decode_median_and_quantiles():
+    # histogram over values 10..14: data = 11,11,11,12,14,14 (total 6)
+    dv = _dv([0, 3, 1, 0, 2])
+    assert st.decode("median", dv, 10, 14) == 11
+    assert st.decode("quantile", dv, 10, 14) == 11     # bare = median
+    assert st.decode("quantile:0.5", dv, 10, 14) == 11
+    assert st.decode("quantile:0.01", dv, 10, 14) == 11
+    assert st.decode("quantile:0.999", dv, 10, 14) == 14
+    assert st.decode("quantile:1.0", dv, 10, 14) == 14
+
+
+def test_decode_top_k_order_and_ties():
+    dv = _dv([2, 5, 0, 5, 1])
+    # count desc, value asc on ties; zero-count values never appear
+    assert st.decode("top_k:3", dv, 0, 4) == [1, 3, 0]
+    assert st.decode("top_k", dv, 0, 4) == [1]         # bare = k=1
+    assert st.decode("top_k:99", dv, 0, 4) == [1, 3, 0, 4]
+
+
+def test_decode_modes_sparse_sentinels():
+    """Empty-window sentinels mirror decode_grouped's ambiguity table:
+    order statistics of nothing are None, top-k of nothing is []."""
+    empty = _dv([0, 0, 0, 0])
+    assert st.decode("median", empty, 0, 3) is None
+    assert st.decode("quantile:0.9", empty, 0, 3) is None
+    assert st.decode("top_k:2", empty, 0, 3) == []
+    one = _dv([1])
+    with pytest.raises(ValueError):
+        st.decode("quantile:0.0", one, 0, 0)
+    with pytest.raises(ValueError):
+        st.decode("quantile:1.5", one, 0, 0)
+    with pytest.raises(ValueError):
+        st.decode("top_k:0", one, 0, 0)
+
+
+def test_decode_grouped_accepts_decode_modes():
+    # group 0 histogram [2, 0, 1] -> median 0; group 1 all-zero -> None
+    vals = np.asarray([2, 0, 1, 0, 0, 0], dtype=np.int64)
+    dv = st.DecryptedVector(values=vals, found=np.ones(6, dtype=bool),
+                            is_zero=(vals == 0))
+    out = st.decode_grouped("median", dv, np.asarray([[0], [1]]), 0, 2)
+    assert out == {(0,): 0, (1,): None}
+
+
+def test_decode_modes_exported():
+    assert set(st.DECODE_MODES) == {"quantile", "median", "top_k"}
+
+
+# -- expired-pane subtraction: exact bytes at the crypto level --------------
+
+def _random_ct_stack(k: int, v: int, seed: int) -> np.ndarray:
+    """(k, V, 2, 3, 16) stack of REAL curve points shaped like per-pane
+    folds (test_topology.py's helper — fixed-base multiples of G1)."""
+    rng = np.random.default_rng(seed)
+    scalars = rng.integers(1, 2 ** 31, size=(k * v * 2,))
+    limbs = np.stack([eg.secret_to_limbs(int(s)) for s in scalars])
+    pts = np.asarray(eg.fixed_base_mul(eg.BASE_TABLE.table, limbs))
+    return pts.reshape(k, v, 2, 3, 16).astype(np.uint32)
+
+
+def test_expired_pane_subtraction_byte_identical():
+    """window - expired + added, canonicalized, equals a from-scratch
+    fold of the new window BYTE for byte (abelian cancellation mod p +
+    canon_points collapsing the representation)."""
+    stack = _random_ct_stack(k=5, v=3, seed=13)
+    # slide by one: fold(0..3) - pane0 + pane4 == fold(1..4)
+    w03 = jnp.asarray(np.asarray(topo.fold_cts(stack[0:4])))
+    cur = eg.ct_sub(w03, jnp.asarray(stack[0]))
+    cur = eg.ct_add(cur, jnp.asarray(stack[4]))
+    delta = np.asarray(topo.canon_points(cur))
+    scratch = np.asarray(topo.fold_cts(stack[1:5]))
+    assert delta.tobytes() == scratch.tobytes()
+
+
+def test_multi_pane_expiry_byte_identical():
+    """A 2-pane slide (expire two, add two) is just as exact — the delta
+    chain's length never accumulates representation error."""
+    stack = _random_ct_stack(k=6, v=2, seed=29)
+    cur = jnp.asarray(np.asarray(topo.fold_cts(stack[0:4])))  # panes 0..3
+    for pid in (0, 1):
+        cur = eg.ct_sub(cur, jnp.asarray(stack[pid]))
+    for pid in (4, 5):
+        cur = eg.ct_add(cur, jnp.asarray(stack[pid]))
+    delta = np.asarray(topo.canon_points(cur))
+    scratch = np.asarray(topo.fold_cts(stack[2:6]))
+    assert delta.tobytes() == scratch.tobytes()
+
+
+def test_subtract_to_empty_then_rebuild():
+    """Subtracting every pane back out returns the identity; re-adding a
+    pane matches that pane's own canonical fold."""
+    stack = _random_ct_stack(k=3, v=2, seed=31)
+    cur = jnp.asarray(np.asarray(topo.fold_cts(stack)))
+    for pid in range(3):
+        cur = eg.ct_sub(cur, jnp.asarray(stack[pid]))
+    rebuilt = np.asarray(topo.canon_points(
+        eg.ct_add(cur, jnp.asarray(stack[1]))))
+    lone = np.asarray(topo.canon_points(jnp.asarray(stack[1])))
+    assert rebuilt.tobytes() == lone.tobytes()
+
+
+# -- full-pipeline streaming (proofs on): identity + reuse ------------------
+# Heavy compiles: slow tier only, one shared cluster (test_service_proofs
+# pattern — these must run in their own process on CPU).
+
+@pytest.fixture(scope="module")
+def cluster_stream():
+    from drynx_tpu.service.service import LocalCluster
+
+    return LocalCluster(n_cns=2, n_dps=2, n_vns=2, seed=1, dlog_limit=4000)
+
+
+PW, W, V = 8, 3, 8
+
+
+def _mk_engine(cluster, stream_id, **kw):
+    from drynx_tpu.service.streaming import StreamEngine
+
+    return StreamEngine(cluster, "frequency_count", 0, V - 1,
+                        stream_id=stream_id, pane_width=PW, window_panes=W,
+                        ranges=[(16, 2)] * V, proofs=1, seed=9, **kw)
+
+
+def _mk_rows(cluster, n_panes, seed):
+    rng = np.random.default_rng(seed)
+    return {d.name: rng.integers(0, V, size=(n_panes, PW)).astype(np.int64)
+            for d in cluster.dp_idents}
+
+
+@pytest.mark.slow
+def test_stream_delta_matches_scratch_at_1_2_4_pane_slides(cluster_stream):
+    cl = cluster_stream
+    from drynx_tpu.server.transcript import transcript_digest
+
+    rows = _mk_rows(cl, 8, seed=0)
+    eng = _mk_engine(cl, "s-ident")
+    sealed = 0
+    for slide in (1, 1, 2, 4):
+        eng.feed({n: r[sealed:sealed + slide].reshape(-1)
+                  for n, r in rows.items()})
+        adv = eng.advance()
+        sealed += slide
+        first = max(0, sealed - W)
+        assert adv.window == (first, sealed - 1)
+        assert adv.survey_id == f"s-ident-w{first}-{sealed - 1}"
+        # ground truth: plain counts over the window's rows
+        truth = Counter()
+        for r in rows.values():
+            truth.update(r[first:sealed].reshape(-1).tolist())
+        assert adv.result == {v: truth.get(v, 0) for v in range(V)}
+        assert adv.block is not None
+        assert all(p.block is not None for p in eng._panes)
+
+        # from-scratch control: a FRESH engine re-fed every row produces
+        # the same survey id, result, decrypted bytes, advance transcript
+        # AND per-pane seal-time transcripts (stream-stable pane sids)
+        dig = transcript_digest(cl.vns, adv.survey_id)
+        pane_digs = [transcript_digest(cl.vns, eng.pane_sid(p))
+                     for p in range(first, sealed)]
+        scratch = _mk_engine(cl, "s-ident")
+        scratch.feed({n: r[:sealed].reshape(-1) for n, r in rows.items()})
+        sadv = scratch.advance()
+        assert sadv.survey_id == adv.survey_id
+        assert sadv.result == adv.result
+        assert (sadv.decrypted.values.tobytes()
+                == adv.decrypted.values.tobytes())
+        assert transcript_digest(cl.vns, sadv.survey_id) == dig
+        assert [transcript_digest(cl.vns, scratch.pane_sid(p))
+                for p in range(first, sealed)] == pane_digs
+        # the scratch engine's seal-time deliveries hit the VN
+        # VerifyCache (same pane sid, same payload bytes): zero fresh
+        # pairings
+        assert scratch.counters["pane_verifies"] == 0
+
+    assert eng.counters["advances"] == 4
+    assert eng.counters["panes_sealed"] == 8
+    # each sealed pane proven once per DP and verified at most once per
+    # DP, at seal time; an advance re-ships NOTHING for carried panes
+    n_dps = len(cl.dp_idents)
+    assert eng.counters["proofs_created"] == 8 * n_dps
+    assert eng.counters["proofs_reused"] == 0
+    assert eng.counters["pane_verifies"] <= 8 * n_dps
+
+
+@pytest.mark.slow
+def test_pane_proof_reuse_byte_identical_across_restart(cluster_stream,
+                                                        tmp_path):
+    cl = cluster_stream
+    from drynx_tpu.server.transcript import transcript_digest
+
+    rows = _mk_rows(cl, 3, seed=4)
+    db = ProofDB(str(tmp_path / "panes.db"))
+    e1 = _mk_engine(cl, "s-reuse", pane_db=db)
+    e1.feed({n: r.reshape(-1) for n, r in rows.items()})
+    a1 = e1.advance()
+    n_dps = len(cl.dp_idents)
+    assert e1.counters["proofs_created"] == 3 * n_dps
+    assert e1.counters["proofs_reused"] == 0
+    blobs1 = {(p.pane_id, d): b for p in e1._panes
+              for d, b in p.blobs.items()}
+    dig1 = transcript_digest(cl.vns, a1.survey_id)
+    pane_digs1 = [transcript_digest(cl.vns, e1.pane_sid(p))
+                  for p in range(3)]
+    db.close()
+
+    # restart: reopened store, fresh engine, same stream id + rows
+    db2 = ProofDB(str(tmp_path / "panes.db"))
+    assert any(k.startswith(b"pane:") for k in db2.keys())
+    assert db2.get(pane_key("s-reuse", 0, cl.dp_idents[0].name)) is not None
+    e2 = _mk_engine(cl, "s-reuse", pane_db=db2)
+    e2.feed({n: r.reshape(-1) for n, r in rows.items()})
+    a2 = e2.advance()
+    assert e2.counters["proofs_created"] == 0
+    assert e2.counters["proofs_reused"] == 3 * n_dps
+    for p in e2._panes:
+        assert p.proofs_reused
+        for d, b in p.blobs.items():
+            assert b == blobs1[(p.pane_id, d)]
+    assert a2.survey_id == a1.survey_id
+    assert a2.result == a1.result
+    assert transcript_digest(cl.vns, a2.survey_id) == dig1
+    assert [transcript_digest(cl.vns, e2.pane_sid(p))
+            for p in range(3)] == pane_digs1
+
+
+@pytest.mark.slow
+def test_scheduler_advance_lane_and_epsilon_admission(cluster_stream,
+                                                      tmp_path):
+    """open_stream/advance_stream round-trip through the scheduler's
+    advance fast lane; an exhausted budget is a typed rejection AT
+    SUBMIT — nothing queues, earlier results stand."""
+    from drynx_tpu.server import admission as adm
+    from drynx_tpu.server.scheduler import SurveyServer
+
+    cl = cluster_stream
+    srv = SurveyServer(cl, pipeline=False)
+    led = pool_mod.EpsilonLedger(str(tmp_path), budget=0.02)
+    eng = _mk_engine(cl, "s-sched", epsilon_ledger=led,
+                     epsilon_per_advance=0.01)
+    assert srv.open_stream(eng, prewarm=False) is eng
+    rows = _mk_rows(cl, 2, seed=7)
+
+    t1 = srv.advance_stream("s-sched",
+                            {n: r[0] for n, r in rows.items()})
+    srv.drain()
+    r1 = srv.results()[t1]
+    assert r1.window == (0, 0)
+    truth = Counter()
+    for r in rows.values():
+        truth.update(r[0].tolist())
+    assert r1.result == {v: truth.get(v, 0) for v in range(V)}
+
+    t2 = srv.advance_stream("s-sched",
+                            {n: r[1] for n, r in rows.items()})
+    srv.drain()
+    assert srv.results()[t2].window == (0, 1)
+
+    # budget 0.02 at 0.01/advance: the third advance rejects at submit
+    with pytest.raises(adm.EpsilonExhausted):
+        srv.advance_stream("s-sched", {n: r[1] for n, r in rows.items()})
+    assert not srv._advance            # nothing queued by the rejection
+    assert led.counters["rejections"] == 1
+    assert eng.counters["advances"] == 2
+    with pytest.raises(KeyError):
+        srv.advance_stream("no-such-stream")
