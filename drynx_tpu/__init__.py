@@ -18,6 +18,17 @@ if os.environ.get("DRYNX_LOCK_TRACE", "0") == "1":
     from .analysis import locktrace as _locktrace
     _locktrace.install()
 
+# Opt-in runtime determinism recorder (analysis/dettrace.py): arm it
+# BEFORE any byte-identity sink (ProofDB.put, transcript serialization,
+# journal appends) can fire, so every write of the process is hashed.
+# The chaos cross-check in tests/test_determinism_analysis.py runs the
+# same proofs-on survey twice with one seed under this and asserts the
+# per-sink write multisets are identical — the dynamic half of the
+# static nondeterminism-taint pass (analysis/determinism.py).
+if os.environ.get("DRYNX_DET_TRACE", "0") == "1":
+    from .analysis import dettrace as _dettrace
+    _dettrace.install()
+
 # Lint-only fast path: the static analyzer (python -m drynx_tpu.analysis)
 # is deliberately jax-free, but importing its parent package triggers
 # ~0.4s of accelerator setup below. DRYNX_SKIP_JAX_INIT=1 skips ALL of it

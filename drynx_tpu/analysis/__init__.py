@@ -3,7 +3,8 @@ invariants (jit-global-capture, cross-module-flag-capture, unsafe-pickle,
 implicit-dtype, host-sync-in-hot-path, pallas-operand-dtype,
 env-read-into-trace, secret-logging, hardcoded-timeout, thread-trace,
 unguarded-shared-mutation, lock-order-inversion,
-blocking-call-under-lock).
+blocking-call-under-lock, nondet-flow-to-transcript,
+unordered-iteration-at-sink).
 
 Per-module rules walk one file; ``[project]`` rules get a
 :class:`ProjectInfo` (import graph + callgraph over the whole package).
@@ -16,6 +17,7 @@ from .core import (REPO_ROOT, RULES, BaselineEntry, Finding, ModuleInfo,
 from .project import ProjectInfo, ProjectRule, analyze_project
 from .dataflow import Dataflow, Secret, dataflow_for
 from .concurrency import Concurrency, concurrency_for
+from .determinism import Determinism, determinism_for
 from .sarif import to_sarif
 from . import rules as _rules  # noqa: F401  (populate the registry)
 from .cli import DEFAULT_BASELINE, main
@@ -23,6 +25,7 @@ from .cli import DEFAULT_BASELINE, main
 __all__ = ["REPO_ROOT", "RULES", "BaselineEntry", "Finding", "ModuleInfo",
            "Rule", "ProjectInfo", "ProjectRule", "Dataflow", "Secret",
            "Concurrency", "concurrency_for",
+           "Determinism", "determinism_for",
            "analyze_paths", "analyze_project", "analyze_source",
            "apply_baseline", "dataflow_for", "load_baseline",
            "module_info_for", "to_sarif", "DEFAULT_BASELINE", "main"]

@@ -1335,3 +1335,65 @@ class BlockingCallUnderLock(ProjectRule):
         for raw in cc.blocking_raw:
             if project.in_focus(raw.file):
                 yield _raw_to_finding(self.id, project, raw)
+
+
+# ---------------------------------------------------------------------------
+# Determinism rules (drynx_tpu/analysis/determinism.py): two thin
+# wrappers over one shared nondeterminism-taint run — determinism_for()
+# memoizes on the same content-hash fingerprint, so the interprocedural
+# source->sink walk is computed once per tree version for both rules
+# (and for the DRYNX_DET_TRACE runtime cross-check).
+
+@register
+class NondetFlowToTranscript(ProjectRule):
+    """A nondeterministic *value* — a wall-clock read, unseeded RNG
+    draw, or object identity (``id()``/``hash()`` under hash
+    randomization) — flows into a byte-identity sink: transcript
+    serialization, a digest, a ProofDB/``pane:``/``ckpt:`` write, a
+    skipchain append, a wire v2 frame encode, or an fsync'd journal
+    line. Those surfaces back the repo's byte-identical-transcript
+    equivalence claims, so any such flow makes two same-seed runs
+    diverge. The finding carries the full source->sink chain as a
+    SARIF codeFlow with dual anchors (suppressible at the source or
+    the sink). Fix by deriving the value from survey inputs (seeded
+    ``fold_in``); a *deliberate* nondeterministic surface — e.g. a
+    block's wall-clock ``sample_time``, excluded from the transcript
+    by design — is declared with ``# drynx: deterministic[reason]``
+    at the source line."""
+
+    id = "nondet-flow-to-transcript"
+    summary = ("wall-clock/RNG/identity value flows into a "
+               "byte-identity sink (transcript, digest, ProofDB, "
+               "skipchain, wire encode, journal)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .determinism import determinism_for
+        det = determinism_for(project, getattr(project, "focus", None))
+        for raw in det.nondet_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class UnorderedIterationAtSink(ProjectRule):
+    """Bytes reach a byte-identity sink in a nondeterministic *order*:
+    a value derived from an unsorted directory listing, a ``set``'s
+    iteration order, or thread-completion order (``as_completed``) is
+    written to a sink — or the sink call itself sits inside a loop
+    over such an iterate, so the write sequence varies run to run even
+    though each individual write is deterministic. Fix by sorting the
+    iterate (``sorted(...)`` with a total key), canonicalizing
+    (``canon_points``/``fold_cts``), or gathering into an
+    index-addressed structure (the roster-order ``fan_out`` result
+    list) before serializing."""
+
+    id = "unordered-iteration-at-sink"
+    summary = ("listing/set/thread-completion order reaches a "
+               "byte-identity sink — write order varies run to run")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .determinism import determinism_for
+        det = determinism_for(project, getattr(project, "focus", None))
+        for raw in det.unordered_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)

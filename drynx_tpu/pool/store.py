@@ -57,6 +57,8 @@ import zipfile
 
 import numpy as np
 
+_DET_TRACE = os.environ.get("DRYNX_DET_TRACE", "0") == "1"
+
 
 def mmap_enabled() -> bool:
     """``DRYNX_POOL_MMAP=off`` is the kill-switch back to eager slab /
@@ -221,9 +223,15 @@ class CryptoPool:
                     self._consumed.add(ev["slab"])
 
     def _ledger_append(self, ev: dict) -> None:
+        line = json.dumps(ev, sort_keys=True)
+        if _DET_TRACE:
+            # laundered: sort_keys canonicalizes the record bytes
+            from ..analysis import dettrace
+            dettrace.record("pool.journal", line, line.encode(),
+                            laundered=True)
         with self._lock:
             with open(self._ledger_path, "a", encoding="utf-8") as f:
-                f.write(json.dumps(ev, sort_keys=True) + "\n")
+                f.write(line + "\n")
                 f.flush()
                 os.fsync(f.fileno())
 
@@ -236,7 +244,7 @@ class CryptoPool:
         re-enter the pool, so they are journaled as ``recover`` and
         deleted."""
         pat = os.path.join(self.root, "dro", "**")
-        for p in glob.glob(pat, recursive=True):
+        for p in sorted(glob.glob(pat, recursive=True)):
             if p.endswith(".tmp"):
                 os.unlink(p)
             elif p.endswith(".claimed"):
@@ -266,6 +274,7 @@ class CryptoPool:
             raise PoolError(f"slab shape mismatch: {zero_ct.shape} vs "
                             f"{r.shape}")
         elems = int(zero_ct.shape[0])
+        # drynx: deterministic[random slab ids name fungible randomness]
         sid = secrets.token_hex(8)
         d = self._slab_dir(digest, elems)
         os.makedirs(d, exist_ok=True)

@@ -1483,6 +1483,7 @@ class DrynxNode:
 
         self.vn.local_bitmaps[survey_id] = merged
         block = self.vn.chain.append(
+            # drynx: deterministic[sample_time is excluded from transcripts]
             DataBlock(survey_id=survey_id, sample_time=time.time(),
                       bitmap=merged))
         return {"block_index": block.index, "block_hash": block.hash(),

@@ -523,6 +523,7 @@ class VNGroup:
         for vn in ready:
             for k, v in vn.bitmap_for(survey_id).items():
                 merged[f"{vn.name}:{k}"] = v
+        # drynx: deterministic[sample_time is excluded from transcripts]
         block_data = DataBlock(survey_id=survey_id, sample_time=time.time(),
                                bitmap=merged)
         self.root.local_bitmaps[survey_id] = merged

@@ -16,6 +16,12 @@ import threading
 
 from ..resilience.policy import named_lock
 
+# DRYNX_DET_TRACE: hash every ProofDB write into the runtime
+# determinism recorder (analysis/dettrace.py) — the dynamic half of
+# the nondeterminism-taint cross-check. Covers pane:/ckpt: blobs,
+# skipchain blocks and checkpoint persistence, all of which land here.
+_DET_TRACE = os.environ.get("DRYNX_DET_TRACE", "0") == "1"
+
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                     "proofdb.cpp")
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native",
@@ -113,6 +119,10 @@ class ProofDB:
 
     def put(self, key: str | bytes, value: bytes) -> None:
         k = key.encode() if isinstance(key, str) else key
+        if _DET_TRACE:
+            from ..analysis import dettrace
+            dettrace.record("proofdb", k.decode("utf-8", "replace"),
+                            value)
         with self._lock:
             if self._lib is not None:
                 rc = self._lib.pdb_put(self._handle(), k, len(k), value,

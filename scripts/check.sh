@@ -24,6 +24,12 @@ echo "== DRYNX_LOCK_TRACE dynamic cross-check runs in the chaos tier) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not chaos' \
     tests/test_concurrency_analysis.py
 
+echo "== determinism tier (taint-engine unit tests + fixture goldens +"
+echo "== real-tree clean gate; the DRYNX_DET_TRACE two-run replay"
+echo "== cross-check runs in the chaos tier) =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not chaos' \
+    tests/test_determinism_analysis.py
+
 echo "== precompile registry smoke (trace+lower the proofs-on program set) =="
 JAX_PLATFORMS=cpu python -m drynx_tpu.precompile --dry-run --quiet
 
@@ -40,10 +46,12 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     tests/test_datasets_timedata.py
 
 echo "== chaos quick tier (seeded fault injection, -m 'chaos and not slow';"
-echo "== + the DRYNX_LOCK_TRACE dynamic/static lock-order cross-check) =="
+echo "== + the DRYNX_LOCK_TRACE dynamic/static lock-order cross-check"
+echo "== + the DRYNX_DET_TRACE same-seed byte-identity replay check) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     -m 'chaos and not slow' tests/test_resilience.py \
-    tests/test_concurrency_analysis.py
+    tests/test_concurrency_analysis.py \
+    tests/test_determinism_analysis.py
 
 echo "== scale smoke (tiny grid points, one supervised child per point) =="
 python scripts/bench_scale_axes.py --cpu --smoke > /dev/null

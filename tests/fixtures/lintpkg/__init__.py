@@ -8,7 +8,11 @@ pallas operand, a pytree dtype-laundering round trip
 (secret-flow-to-sink, which absorbs the regex secret-logging hit on the
 same line). concurrency.py adds the four concurrency violations: two
 unguarded-shared-mutation sites, a 2-lock order inversion, and a
-blocking sleep under both locks. tests/test_static_analysis.py asserts
-the CLI reports exactly these eleven, each with a rendered
+blocking sleep under both locks. determinism.py adds the four
+determinism violations: a wall-clock read and an os.urandom draw
+reaching byte-identity sinks (nondet-flow-to-transcript x2), plus a
+set-iteration write loop and an unsorted-listing digest
+(unordered-iteration-at-sink x2). tests/test_static_analysis.py
+asserts the CLI reports exactly these fifteen, each with a rendered
 call/value chain.
 """

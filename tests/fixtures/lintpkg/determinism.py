@@ -1,0 +1,65 @@
+"""Determinism fixture: nondeterministic flows into byte-identity sinks.
+
+Exactly four determinism violations, exercising both project rules from
+drynx_tpu/analysis/determinism.py:
+
+* ``digest_with_stamp`` folds a wall-clock read (via the ``stamp``
+  helper — the chain is interprocedural) into a sha256 — one
+  ``nondet-flow-to-transcript`` with a 3-hop codeFlow.
+* ``persist_nonce`` writes ``os.urandom`` bytes through a 2-arg
+  ``.put`` — one ``nondet-flow-to-transcript``.
+* ``journal_members`` iterates a ``set(...)`` with a db write in the
+  loop body — one ``unordered-iteration-at-sink`` (the write *order*
+  is the hazard).
+* ``digest_dir`` hashes an unsorted ``os.listdir`` — one
+  ``unordered-iteration-at-sink``.
+
+Negative controls that must NOT be reported: ``digest_dir_sorted``
+launders the listing through ``sorted(...)``; ``stamp_marked`` declares
+its wall-clock read with ``# drynx: deterministic[reason]``; and
+``digest_seeded`` draws from a *seeded* ``random.Random`` instance.
+"""
+import hashlib
+import os
+import random
+import time
+
+
+def stamp() -> float:
+    return time.time()
+
+
+def digest_with_stamp(payload: bytes) -> str:
+    stamp_v = stamp()
+    return hashlib.sha256(payload + str(stamp_v).encode()).hexdigest()
+
+
+def persist_nonce(db) -> None:
+    nonce = os.urandom(16)
+    db.put("nonce", nonce)
+
+
+def journal_members(db, members) -> None:
+    for name in set(members):
+        db.put(f"member:{name}", b"\x01")
+
+
+def digest_dir(path: str) -> str:
+    names = os.listdir(path)
+    return hashlib.sha256("".join(names).encode()).hexdigest()
+
+
+def digest_dir_sorted(path: str) -> str:
+    names = sorted(os.listdir(path))
+    return hashlib.sha256("".join(names).encode()).hexdigest()
+
+
+def stamp_marked(db) -> None:
+    t = time.time()  # drynx: deterministic[fixture: display-only stamp]
+    db.put("stamp", str(t).encode())
+
+
+def digest_seeded(payload: bytes) -> str:
+    rng = random.Random(7)
+    return hashlib.sha256(payload
+                          + bytes([rng.randrange(256)])).hexdigest()

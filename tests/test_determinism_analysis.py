@@ -1,0 +1,666 @@
+"""Determinism analysis pass: unit tests for the taint engine, goldens
+for the fixture package, and the dynamic replay cross-check.
+
+Engine unit tests build tiny synthetic projects with
+ProjectInfo.from_sources (same idiom as test_concurrency_analysis.py)
+and inspect the Determinism findings directly. The chaos-marker test at
+the bottom is the dynamic half of the prover: it runs the SAME
+proofs-on survey twice in child processes under DRYNX_DET_TRACE=1 with
+one seed and asserts the per-sink write multisets are byte-identical —
+if the static pass says the tree is clean, two same-seed runs must not
+diverge at any byte-identity sink.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from drynx_tpu.analysis import RULES, ProjectInfo
+from drynx_tpu.analysis.determinism import Determinism, determinism_for
+from drynx_tpu.analysis.core import suppressed_at
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "drynx_tpu"
+GOLDEN_DET = REPO_ROOT / "tests" / "fixtures" / "lintpkg_determinism.json"
+GOLDEN_FLOW = REPO_ROOT / "tests" / "fixtures" / "lintpkg_det_codeflow.json"
+
+DET_RULES = {"nondet-flow-to-transcript", "unordered-iteration-at-sink"}
+
+
+def det_of(pairs):
+    project = ProjectInfo.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in pairs])
+    return Determinism(project).run()
+
+
+def findings_of(pairs):
+    """The two determinism project rules over a synthetic project, with
+    noqa suppression applied — the analyze_project slice that matters
+    here, without re-reading the tree from disk."""
+    project = ProjectInfo.from_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in pairs])
+    findings = []
+    for rid in sorted(DET_RULES):
+        findings.extend(RULES[rid].run_project(project))
+    findings = [f for f in findings
+                if not suppressed_at(f, project.modules)]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# -- value sources -----------------------------------------------------------
+
+def test_wall_clock_into_digest_is_flagged():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import time
+
+        def fingerprint(payload: bytes) -> str:
+            t = time.time()
+            return hashlib.sha256(payload + str(t).encode()).hexdigest()
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+    assert fs[0].line == 6
+    assert "wall-clock" in fs[0].message
+
+
+@pytest.mark.parametrize("expr", [
+    "os.urandom(8)",
+    "secrets.token_hex(8)",
+    "uuid.uuid4().hex.encode()",
+    "random.random()",
+])
+def test_unseeded_rng_into_db_put_is_flagged(expr):
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import os
+        import random
+        import secrets
+        import uuid
+
+        def persist(db):
+            v = %s
+            db.put("k", str(v).encode())
+    """ % expr)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+    assert "rng" in fs[0].message
+
+
+def test_seeded_generators_are_clean():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import random
+
+        import numpy as np
+
+        def seeded(payload: bytes) -> str:
+            a = random.Random(7).randrange(256)
+            b = int(np.random.default_rng(13).integers(0, 256))
+            return hashlib.sha256(payload + bytes([a, b])).hexdigest()
+    """)]) == []
+
+
+def test_unseeded_default_rng_is_flagged():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+
+        import numpy as np
+
+        def unseeded(payload: bytes) -> str:
+            v = int(np.random.default_rng().integers(0, 256))
+            return hashlib.sha256(payload + bytes([v])).hexdigest()
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+
+
+def test_identity_sources_are_flagged():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import os
+
+        def persist(db, obj):
+            db.put("pid", str(os.getpid()).encode())
+            db.put("obj", str(id(obj)).encode())
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"] * 2
+    assert all("identity" in f.message for f in fs)
+
+
+def test_comparison_against_clock_is_control_not_data():
+    # deadline checks READ the clock but only branch on it — the bytes
+    # written are clock-independent, so nothing flows
+    assert findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def wait_and_persist(db, payload: bytes) -> None:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 5.0:
+                pass
+            db.put("k", payload)
+    """)]) == []
+
+
+# -- order-hazard sources ----------------------------------------------------
+
+def test_unsorted_listdir_into_digest_is_flagged():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import os
+
+        def tree_digest(path: str) -> str:
+            names = os.listdir(path)
+            return hashlib.sha256("".join(names).encode()).hexdigest()
+    """)])
+    assert [f.rule for f in fs] == ["unordered-iteration-at-sink"]
+    assert "listing" in fs[0].message
+
+
+def test_sorted_listdir_is_clean():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import os
+
+        def tree_digest(path: str) -> str:
+            names = sorted(os.listdir(path))
+            return hashlib.sha256("".join(names).encode()).hexdigest()
+    """)]) == []
+
+
+def test_set_iteration_writing_in_loop_is_flagged():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        def journal(db, members):
+            for name in set(members):
+                db.put("m:" + name, b"1")
+    """)])
+    assert [f.rule for f in fs] == ["unordered-iteration-at-sink"]
+    assert "set" in fs[0].message
+
+
+def test_sorted_set_iteration_is_clean():
+    assert findings_of([("drynx_tpu/a.py", """\
+        def journal(db, members):
+            for name in sorted(set(members)):
+                db.put("m:" + name, b"1")
+    """)]) == []
+
+
+def test_dict_iteration_is_clean():
+    # dicts are insertion-ordered in CPython — not an order hazard
+    assert findings_of([("drynx_tpu/a.py", """\
+        def journal(db, table):
+            for k, v in table.items():
+                db.put(k, v)
+    """)]) == []
+
+
+def test_as_completed_order_reaches_encode():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        from concurrent.futures import as_completed
+
+        from .wire import encode_frame
+
+        def gather(futs) -> bytes:
+            out = []
+            for f in as_completed(futs):
+                out.append(f.result())
+            return encode_frame({"rows": out})
+    """)])
+    assert [f.rule for f in fs] == ["unordered-iteration-at-sink"]
+    assert "thread-order" in fs[0].message
+
+
+def test_roster_indexed_store_launders_completion_order():
+    # results[i] = ... reconstructs roster order regardless of which
+    # future finished first — the canonical fan_out/gather idiom
+    assert findings_of([("drynx_tpu/a.py", """\
+        from concurrent.futures import as_completed
+
+        from .wire import encode_frame
+
+        def gather(futs) -> bytes:
+            out = [None] * len(futs)
+            for f in as_completed(futs):
+                i, v = f.result()
+                out[i] = v
+            return encode_frame({"rows": out})
+    """)]) == []
+
+
+def test_order_insensitive_reduction_launders_listing():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import glob
+        import os
+
+        def persist_counts(db, path: str) -> None:
+            db.put("n", str(len(os.listdir(path))).encode())
+            db.put("g", str(sum(1 for _ in glob.glob(path))).encode())
+    """)]) == []
+
+
+# -- launders ----------------------------------------------------------------
+
+def test_canon_points_launders_order():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import os
+
+        from .crypto import canon_points
+
+        def digest_points(path: str) -> str:
+            pts = canon_points(os.listdir(path))
+            return hashlib.sha256(repr(pts).encode()).hexdigest()
+    """)]) == []
+
+
+def test_fold_in_is_passthrough_not_launder():
+    # fold_in derives keys deterministically FROM its inputs: a clean
+    # key stays clean, a tainted one stays tainted
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import time
+
+        from jax import random
+
+        def clean(payload: bytes) -> str:
+            k = random.fold_in(random.PRNGKey(0), 3)
+            return hashlib.sha256(payload + repr(k).encode()).hexdigest()
+
+        def dirty(payload: bytes) -> str:
+            k = random.fold_in(random.PRNGKey(int(time.time())), 3)
+            return hashlib.sha256(payload + repr(k).encode()).hexdigest()
+    """)])
+    assert [(f.rule, f.line) for f in fs] == \
+        [("nondet-flow-to-transcript", 12)]
+
+
+def test_deterministic_marker_kills_taint_at_source():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def persist_stamp(db) -> None:
+            t = time.time()  # drynx: deterministic[display-only stamp]
+            db.put("stamp", str(t).encode())
+    """)]) == []
+
+
+def test_deterministic_marker_on_comment_line_above():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def persist_stamp(db) -> None:
+            # drynx: deterministic[display-only stamp]
+            t = time.time()
+            db.put("stamp", str(t).encode())
+    """)]) == []
+
+
+def test_marker_reason_is_required():
+    # a bare marker with no [reason] is NOT a launder
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def persist_stamp(db) -> None:
+            t = time.time()  # drynx: deterministic
+            db.put("stamp", str(t).encode())
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+
+
+# -- sinks -------------------------------------------------------------------
+
+def test_one_arg_put_is_not_a_sink():
+    # queue.put(item) is a queue, not a keyed byte store
+    assert findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def enqueue(q) -> None:
+            q.put(time.time())
+    """)]) == []
+
+
+def test_chain_append_and_journal_are_sinks():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        class Node:
+            def seal(self, chain) -> None:
+                chain.append({"t": time.time()})
+
+            def journal(self) -> None:
+                self._ledger_append({"t": time.time()})
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"] * 2
+    assert {f.line for f in fs} == {5, 8}
+
+
+def test_plain_list_append_is_not_a_sink():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def collect(samples) -> None:
+            samples.append(time.time())
+    """)]) == []
+
+
+# -- interprocedural ---------------------------------------------------------
+
+def test_taint_returned_through_helper_carries_chain():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import time
+
+        def stamp() -> float:
+            return time.time()
+
+        def fingerprint(payload: bytes) -> str:
+            v = stamp()
+            return hashlib.sha256(payload + str(v).encode()).hexdigest()
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+    assert fs[0].line == 9
+    # 3 hops: the time.time() read, the stamp() call site, the sink
+    assert len(fs[0].call_chain) == 3
+    assert ":5:" in fs[0].call_chain[0]
+
+
+def test_tainted_argument_reaches_sink_inside_callee():
+    fs = findings_of([("drynx_tpu/a.py", """\
+        import time
+
+        def persist(db, value) -> None:
+            db.put("k", str(value).encode())
+
+        def caller(db) -> None:
+            persist(db, time.time())
+    """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+    # the finding lands AT the sink (inside the callee) with the call
+    # site as the secondary anchor for noqa
+    assert fs[0].line == 4
+    anchor_lines = {line for _, line in fs[0].anchors}
+    assert 7 in anchor_lines
+
+
+def test_cross_module_flow_is_tracked():
+    fs = findings_of([
+        ("drynx_tpu/util.py", """\
+            import time
+
+            def now() -> float:
+                return time.time()
+        """),
+        ("drynx_tpu/writer.py", """\
+            import hashlib
+
+            from .util import now
+
+            def fingerprint(payload: bytes) -> str:
+                return hashlib.sha256(
+                    payload + str(now()).encode()).hexdigest()
+        """)])
+    assert [f.rule for f in fs] == ["nondet-flow-to-transcript"]
+    assert fs[0].file == "drynx_tpu/writer.py"
+    assert any("util.py" in hop for hop in fs[0].call_chain)
+
+
+# -- suppression -------------------------------------------------------------
+
+def test_noqa_at_sink_line_suppresses():
+    assert findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import time
+
+        def fingerprint(payload: bytes) -> str:
+            t = time.time()
+            return hashlib.sha256(  # drynx: noqa[nondet-flow-to-transcript]
+                payload + str(t).encode()).hexdigest()
+    """)]) == []
+
+
+def test_noqa_at_source_anchor_suppresses():
+    # dual anchors: the noqa can sit at the SOURCE end of the flow too
+    assert findings_of([("drynx_tpu/a.py", """\
+        import hashlib
+        import time
+
+        def fingerprint(payload: bytes) -> str:
+            t = time.time()  # drynx: noqa[nondet-flow-to-transcript]
+            return hashlib.sha256(payload + str(t).encode()).hexdigest()
+    """)]) == []
+
+
+# -- fixture goldens ---------------------------------------------------------
+
+def _fixture_findings():
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.analysis", "--format", "json",
+         "--no-baseline", "tests/fixtures/lintpkg"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    return json.loads(proc.stdout)["findings"]
+
+
+def test_fixture_determinism_findings_match_golden():
+    got = [f for f in _fixture_findings() if f["rule"] in DET_RULES]
+    got.sort(key=lambda f: (f["file"], f["line"], f["rule"]))
+    want = json.loads(GOLDEN_DET.read_text())
+    assert got == want, (
+        "determinism findings drifted from the golden; if intentional, "
+        "regenerate tests/fixtures/lintpkg_determinism.json")
+
+
+def test_fixture_sarif_codeflow_matches_golden():
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.analysis", "--format", "sarif",
+         "--no-baseline", "tests/fixtures/lintpkg"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    sarif = json.loads(proc.stdout)
+    results = [r for r in sarif["runs"][0]["results"]
+               if r["ruleId"] == "nondet-flow-to-transcript"
+               and r["locations"][0]["physicalLocation"]["region"]
+                   ["startLine"] == 34]
+    assert len(results) == 1
+    got = results[0]["codeFlows"]
+    want = json.loads(GOLDEN_FLOW.read_text())
+    assert got == want, (
+        "the interprocedural codeFlow drifted from the golden; if "
+        "intentional, regenerate tests/fixtures/lintpkg_det_codeflow.json")
+
+
+def test_list_rules_shows_both_determinism_rules_as_project():
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "drynx_tpu.analysis", "--list-rules"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for rid in sorted(DET_RULES):
+        line = next(ln for ln in proc.stdout.splitlines() if rid in ln)
+        assert "[project]" in line, line
+
+
+# -- the real tree -----------------------------------------------------------
+
+def test_real_tree_is_clean_and_fast():
+    # fresh interpreter, the way check.sh runs it; the <5s budget is the
+    # acceptance bar for the determinism pass alone on the full tree
+    # (measured ~0.35s engine + ~1.8s project build on an idle core —
+    # generous headroom for loaded CI)
+    prog = (
+        "import json, sys, time\n"
+        "from drynx_tpu.analysis import RULES, ProjectInfo\n"
+        "from drynx_tpu.analysis.determinism import determinism_for\n"
+        "project, errors = ProjectInfo.from_paths([%r])\n"
+        "assert errors == []\n"
+        "t0 = time.monotonic()\n"
+        "det = determinism_for(project)\n"
+        "findings = []\n"
+        "for rid in %r:\n"
+        "    findings.extend(RULES[rid].run_project(project))\n"
+        "elapsed = time.monotonic() - t0\n"
+        "json.dump({'elapsed': elapsed,\n"
+        "           'findings': [f.render() for f in findings],\n"
+        "           'sinks': sorted(det.sink_sites.values()),\n"
+        "           'launders': sorted(set(det.launder_sites.values())),\n"
+        "           'n_launders': len(det.launder_sites),\n"
+        "           'sources': len(det.source_sites),\n"
+        "           'markers': len(det.marker_sites)}, sys.stdout)\n"
+        % (str(PACKAGE), sorted(DET_RULES)))
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run([sys.executable, "-c", prog], cwd=str(REPO_ROOT),
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["findings"] == [], "\n".join(out["findings"])
+    assert out["elapsed"] < 5.0, \
+        f"determinism pass took {out['elapsed']:.1f}s (budget 5s)"
+    # non-vacuity: a clean verdict is only meaningful if the pass saw
+    # the tree's byte-identity surface — distinct sink LABELS (digest,
+    # skipchain, db-write, journal, wire-encode) and launder KINDS
+    assert len(out["sinks"]) >= 5, out["sinks"]
+    assert len(set(out["sinks"])) >= 4, sorted(set(out["sinks"]))
+    assert len(out["launders"]) >= 3, out["launders"]
+    assert out["n_launders"] >= 20, out["n_launders"]
+    assert out["sources"] >= 20, out["sources"]
+    # the three declared exemptions (sample_time, slab ids) are visible
+    assert out["markers"] >= 3, out["markers"]
+
+
+def test_changed_only_focus_is_fast_and_respected():
+    # the marginal cost of the determinism stage under --changed-only:
+    # build the project once (shared with every other pass), then time
+    # ONLY the focused determinism run for a one-leaf change
+    prog = (
+        "import json, sys, time\n"
+        "from drynx_tpu.analysis import RULES, ProjectInfo\n"
+        "from drynx_tpu.analysis.determinism import determinism_for\n"
+        "project, errors = ProjectInfo.from_paths([%r])\n"
+        "assert errors == []\n"
+        "focus = project.impacted_relpaths("
+        "['drynx_tpu/server/transcript.py'])\n"
+        "project.focus = focus\n"
+        "t0 = time.monotonic()\n"
+        "det = determinism_for(project, frozenset(focus))\n"
+        "findings = []\n"
+        "for rid in %r:\n"
+        "    findings.extend(RULES[rid].run_project(project))\n"
+        "elapsed = time.monotonic() - t0\n"
+        "json.dump({'elapsed': elapsed, 'n_focus': len(focus),\n"
+        "           'findings': [f.render() for f in findings]},\n"
+        "          sys.stdout)\n"
+        % (str(PACKAGE), sorted(DET_RULES)))
+    env = dict(os.environ, DRYNX_SKIP_JAX_INIT="1")
+    proc = subprocess.run([sys.executable, "-c", prog], cwd=str(REPO_ROOT),
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["findings"] == []
+    assert out["n_focus"] >= 1
+    assert out["elapsed"] < 2.0, \
+        f"focused determinism stage took {out['elapsed']:.2f}s (budget 2s)"
+
+
+def test_focus_narrows_reported_files():
+    pairs = [("drynx_tpu/aa.py", textwrap.dedent("""\
+        import hashlib
+        import time
+
+        def fp_a(payload: bytes) -> str:
+            return hashlib.sha256(
+                payload + str(time.time()).encode()).hexdigest()
+    """)), ("drynx_tpu/bb.py", textwrap.dedent("""\
+        import hashlib
+        import time
+
+        def fp_b(payload: bytes) -> str:
+            return hashlib.sha256(
+                payload + str(time.time()).encode()).hexdigest()
+    """))]
+    project = ProjectInfo.from_sources(pairs)
+    project.focus = {"drynx_tpu/aa.py"}
+    findings = list(RULES["nondet-flow-to-transcript"].run_project(project))
+    assert {f.file for f in findings} == {"drynx_tpu/aa.py"}
+
+
+# -- dynamic cross-check -----------------------------------------------------
+
+_TRACE_CHILD = """\
+import json, os, sys, tempfile
+from drynx_tpu.analysis import dettrace
+assert dettrace.installed(), "DRYNX_DET_TRACE=1 did not install"
+
+import numpy as np
+from drynx_tpu.server import SurveyServer, survey_transcript
+from drynx_tpu.service.service import LocalCluster
+from drynx_tpu.service.store import ProofDB
+from drynx_tpu.pool.epsilon import EpsilonLedger
+
+cl = LocalCluster(n_cns=2, n_dps=2, n_vns=2, seed=13, dlog_limit=4000)
+rng = np.random.default_rng(5)
+for name, dp in cl.dps.items():
+    dp.data = rng.integers(0, 4, size=(2,)).astype(np.int64)
+
+sq = cl.generate_survey_query("sum", query_min=0, query_max=15, proofs=1,
+                              ranges=[(4, 2)], survey_id="det0")
+srv = SurveyServer(cl, max_batch=1, pipeline=False)
+srv.submit(sq)
+results = srv.drain()
+assert "det0" in results, sorted(results)
+
+blob = survey_transcript(cl.vns, "det0")
+assert blob, "proofs-on survey produced an empty transcript"
+
+# exercise the other instrumented byte-identity surfaces with
+# deterministic content: a keyed ProofDB write and an epsilon-journal
+# charge — both must hash identically across same-seed runs
+with tempfile.TemporaryDirectory() as td:
+    db = ProofDB(os.path.join(td, "p.db"))
+    db.put("pane:det0/0", blob)
+    led = EpsilonLedger(os.path.join(td, "eps"), budget=10.0)
+    led.charge("dp0", "det0", 0.5)
+
+json.dump(dettrace.snapshot(), sys.stdout)
+"""
+
+
+@pytest.mark.chaos
+def test_same_seed_runs_are_byte_identical_at_every_sink():
+    """Replay cross-check: the static pass claims the tree is
+    deterministic modulo the three declared markers. Run the same
+    proofs-on survey twice with one seed under the runtime recorder and
+    assert the per-sink write multisets match byte-for-byte. The
+    skipchain block store is exempt — its blocks embed sample_time,
+    which the marker declares excluded from transcripts."""
+    env = dict(os.environ, DRYNX_DET_TRACE="1", JAX_PLATFORMS="cpu")
+    snaps = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c", _TRACE_CHILD],
+                              cwd=str(REPO_ROOT), capture_output=True,
+                              text=True, env=env, timeout=600)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        snaps.append(json.loads(proc.stdout))
+
+    from drynx_tpu.analysis import dettrace
+    a, b = snaps
+    # non-vacuity: the recorder must have seen real writes, including
+    # the laundered surfaces the static pass trusts (the canonicalized
+    # transcript and the sort_keys epsilon journal)
+    for snap in snaps:
+        assert snap["writes"] > 0, snap
+        keys = set(snap["records"])
+        assert any(k.startswith("transcript:") for k in keys), sorted(keys)
+        assert any(k.startswith("epsilon.journal:") for k in keys)
+        assert any(k.startswith("proofdb:pane:") for k in keys)
+        assert set(snap["laundered"]) & keys
+
+    diverged = dettrace.divergence(a, b, exempt=("proofdb:chain/block",))
+    assert diverged == [], (
+        f"same-seed runs diverged at byte-identity sinks {diverged} — "
+        f"either real nondeterminism the static pass missed, or a "
+        f"marker/launder that does not hold at runtime")

@@ -34,7 +34,8 @@ def test_registry_has_the_documented_rules():
                 "secret-logging", "hardcoded-timeout", "thread-trace",
                 "ciphertext-dtype-launder", "secret-flow-to-sink",
                 "unguarded-shared-mutation", "lock-order-inversion",
-                "blocking-call-under-lock"}
+                "blocking-call-under-lock", "nondet-flow-to-transcript",
+                "unordered-iteration-at-sink"}
     assert expected <= set(RULES), sorted(expected - set(RULES))
 
 
@@ -127,7 +128,7 @@ def test_list_rules_marks_project_rules():
     assert "unsafe-pickle:" in proc.stdout  # per-module rules unmarked
 
 
-def test_fixture_package_yields_exactly_the_eleven_findings():
+def test_fixture_package_yields_exactly_the_fifteen_findings():
     proc = _cli([str(FIXTURE), "--no-baseline"])
     assert proc.returncode == 1, proc.stdout + proc.stderr
     out = proc.stdout
@@ -140,7 +141,11 @@ def test_fixture_package_yields_exactly_the_eleven_findings():
     assert out.count("[secret-flow-to-sink]") == 3, out
     # UNGUARDED is bumped bare from both thread entries: one per site
     assert out.count("[unguarded-shared-mutation]") == 2, out
-    assert out.count("call chain:") == 11, out
+    # determinism.py: time->digest + urandom->put, set-iteration +
+    # unsorted-listing — two per determinism rule
+    assert out.count("[nondet-flow-to-transcript]") == 2, out
+    assert out.count("[unordered-iteration-at-sink]") == 2, out
+    assert out.count("call chain:") == 15, out
 
 
 def test_json_format_has_stable_call_chain_field():
@@ -148,7 +153,7 @@ def test_json_format_has_stable_call_chain_field():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     findings = data["findings"]
-    assert len(findings) == 11
+    assert len(findings) == 15
     for f in findings:
         assert isinstance(f["call_chain"], list) and f["call_chain"]
         assert all(isinstance(h, str) for h in f["call_chain"])
