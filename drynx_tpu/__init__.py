@@ -29,6 +29,18 @@ if os.environ.get("DRYNX_DET_TRACE", "0") == "1":
     from .analysis import dettrace as _dettrace
     _dettrace.install()
 
+# Opt-in runtime protocol recorder (analysis/prototrace.py): arm it
+# BEFORE any resource lifecycle (pool slab consumption, ConnPool
+# checkouts, pane seals, checkpoint saves) can fire, so every
+# instance's event sequence is captured from creation. The chaos
+# cross-check in tests/test_typestate_analysis.py drives a proofs-on
+# survey plus a pool consume/crash-recover cycle under this and
+# asserts every observed sequence is accepted by the declared automata
+# — the dynamic half of the static typestate pass (analysis/typestate.py).
+if os.environ.get("DRYNX_PROTO_TRACE", "0") == "1":
+    from .analysis import prototrace as _prototrace
+    _prototrace.install()
+
 # Lint-only fast path: the static analyzer (python -m drynx_tpu.analysis)
 # is deliberately jax-free, but importing its parent package triggers
 # ~0.4s of accelerator setup below. DRYNX_SKIP_JAX_INIT=1 skips ALL of it

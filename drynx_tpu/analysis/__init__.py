@@ -4,7 +4,8 @@ implicit-dtype, host-sync-in-hot-path, pallas-operand-dtype,
 env-read-into-trace, secret-logging, hardcoded-timeout, thread-trace,
 unguarded-shared-mutation, lock-order-inversion,
 blocking-call-under-lock, nondet-flow-to-transcript,
-unordered-iteration-at-sink).
+unordered-iteration-at-sink, atomic-durable-write,
+slab-consumption-order, conn-checkout-discipline, seal-commit-once).
 
 Per-module rules walk one file; ``[project]`` rules get a
 :class:`ProjectInfo` (import graph + callgraph over the whole package).
@@ -18,6 +19,7 @@ from .project import ProjectInfo, ProjectRule, analyze_project
 from .dataflow import Dataflow, Secret, dataflow_for
 from .concurrency import Concurrency, concurrency_for
 from .determinism import Determinism, determinism_for
+from .typestate import Typestate, typestate_for
 from .sarif import to_sarif
 from . import rules as _rules  # noqa: F401  (populate the registry)
 from .cli import DEFAULT_BASELINE, main
@@ -26,6 +28,7 @@ __all__ = ["REPO_ROOT", "RULES", "BaselineEntry", "Finding", "ModuleInfo",
            "Rule", "ProjectInfo", "ProjectRule", "Dataflow", "Secret",
            "Concurrency", "concurrency_for",
            "Determinism", "determinism_for",
+           "Typestate", "typestate_for",
            "analyze_paths", "analyze_project", "analyze_source",
            "apply_baseline", "dataflow_for", "load_baseline",
            "module_info_for", "to_sarif", "DEFAULT_BASELINE", "main"]

@@ -20,7 +20,7 @@ import json
 import subprocess
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .core import (REPO_ROOT, RULES, analyze_paths, apply_baseline,
                    load_baseline)
@@ -117,11 +117,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
+        # grouped by backing engine: the lexical per-module rules, the
+        # callgraph [project] rules, then one section per whole-program
+        # engine (dataflow/concurrency/determinism/typestate)
+        order = ["lint", "project", "dataflow", "concurrency",
+                 "determinism", "typestate"]
+        by_engine: Dict[str, List[Tuple[str, object]]] = {}
         for rid, rule in sorted(RULES.items()):
-            mark = " [project]" if rule.project else ""
-            if rule.seed_only:
-                mark += " [seed-only]"
-            print(f"{rid}{mark}: {rule.summary}")
+            by_engine.setdefault(rule.engine, []).append((rid, rule))
+        for engine in order + sorted(set(by_engine) - set(order)):
+            if engine not in by_engine:
+                continue
+            print(f"[{engine}]")
+            for rid, rule in by_engine[engine]:
+                mark = " [project]" if rule.project else ""
+                if rule.seed_only:
+                    mark += " [seed-only]"
+                print(f"  {rid}{mark}: {rule.summary}")
         return 0
 
     for rid in args.rules or ():

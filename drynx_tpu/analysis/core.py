@@ -77,6 +77,9 @@ class Rule:
     id: str = ""
     summary: str = ""
     project = False    # ProjectRule flips this; --list-rules marks it
+    engine = "lint"    # which analysis engine backs the rule; --list-rules
+    #                    groups by it (lint/project/dataflow/concurrency/
+    #                    determinism/typestate)
     seed_only = False  # kept as a seed list for a dataflow successor rule
     absorbs: Tuple[str, ...] = ()  # rule ids this rule's findings dedupe
 
@@ -200,15 +203,30 @@ class ModuleInfo:
 
         # Functions that build a pallas_call: their bodies are evaluated at
         # trace time and the kernel config (e.g. interpret=FLAG) is baked
-        # into the jit trace of whichever caller jits them.
-        self.pallas_functions = []
-        for f in self.functions:
-            for sub in ast.walk(f):
-                if isinstance(sub, ast.Call):
-                    d = _dotted(sub.func)
+        # into the jit trace of whichever caller jits them. One DFS over
+        # the module with an enclosing-function stack — re-walking every
+        # function subtree is quadratic under nesting. The same pass
+        # collects `global` declarations for the rebound set below.
+        pallas_set: Set[ast.AST] = set()
+        global_names: Set[str] = set()
+
+        def _scan(node: ast.AST, stack: Tuple[ast.AST, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call):
+                    d = _dotted(child.func)
                     if d and d.split(".")[-1] == "pallas_call":
-                        self.pallas_functions.append(f)
-                        break
+                        pallas_set.update(stack)
+                elif isinstance(child, ast.Global) and stack:
+                    global_names.update(child.names)
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _scan(child, stack + (child,))
+                else:
+                    _scan(child, stack)
+
+        _scan(self.tree, ())
+        self.pallas_functions = [f for f in self.functions
+                                 if f in pallas_set]
         self.traced_functions = list(dict.fromkeys(
             self.jit_functions + self.pallas_functions))
 
@@ -231,10 +249,7 @@ class ModuleInfo:
         self.rebound: Set[str] = {
             name for name, assigns in self.module_assigns.items()
             if len(assigns) > 1}
-        for f in self.functions:
-            for sub in ast.walk(f):
-                if isinstance(sub, ast.Global):
-                    self.rebound.update(sub.names)
+        self.rebound.update(global_names)
 
     # -- helpers ----------------------------------------------------------
 

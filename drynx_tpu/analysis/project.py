@@ -201,6 +201,7 @@ class ProjectRule(Rule):
     ``--list-rules`` marks these ``[project]``."""
 
     project = True
+    engine = "project"
 
     def run(self, mod: ModuleInfo) -> Iterator[Finding]:
         return iter(())

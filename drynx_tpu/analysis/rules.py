@@ -1212,6 +1212,7 @@ class CiphertextDtypeLaunder(ProjectRule):
     and ``# drynx: declassify[dtype]`` marks deliberate byte-packing."""
 
     id = "ciphertext-dtype-launder"
+    engine = "dataflow"
     summary = ("uint32 limb value reaches a pallas/jit kernel or "
                "serialization after a dtype-laundering hop (value "
                "dataflow)")
@@ -1240,6 +1241,7 @@ class SecretFlowToSink(ProjectRule):
     defining assignment."""
 
     id = "secret-flow-to-sink"
+    engine = "dataflow"
     summary = ("secret value (keygen/nonce/DP cleartext) reaches a "
                "log/print/serialization/exception/send sink (value "
                "dataflow)")
@@ -1275,6 +1277,7 @@ class UnguardedSharedMutation(ProjectRule):
     lock (see ``resilience.policy.named_lock``)."""
 
     id = "unguarded-shared-mutation"
+    engine = "concurrency"
     summary = ("shared state mutated from multiple thread contexts with "
                "no common lock held (interprocedural lock-set analysis)")
 
@@ -1300,6 +1303,7 @@ class LockOrderInversion(ProjectRule):
     or collapsing to a single lock."""
 
     id = "lock-order-inversion"
+    engine = "concurrency"
     summary = ("named locks acquired in conflicting order on different "
                "paths — ABBA deadlock cycle in the lock-order graph")
 
@@ -1326,6 +1330,7 @@ class BlockingCallUnderLock(ProjectRule):
     conversation — suppress at the site with a reason."""
 
     id = "blocking-call-under-lock"
+    engine = "concurrency"
     summary = ("socket/sleep/subprocess/join reachable while holding a "
                "lock — serializes every contending thread")
 
@@ -1362,6 +1367,7 @@ class NondetFlowToTranscript(ProjectRule):
     at the source line."""
 
     id = "nondet-flow-to-transcript"
+    engine = "determinism"
     summary = ("wall-clock/RNG/identity value flows into a "
                "byte-identity sink (transcript, digest, ProofDB, "
                "skipchain, wire encode, journal)")
@@ -1388,6 +1394,7 @@ class UnorderedIterationAtSink(ProjectRule):
     list) before serializing."""
 
     id = "unordered-iteration-at-sink"
+    engine = "determinism"
     summary = ("listing/set/thread-completion order reaches a "
                "byte-identity sink — write order varies run to run")
 
@@ -1395,5 +1402,120 @@ class UnorderedIterationAtSink(ProjectRule):
         from .determinism import determinism_for
         det = determinism_for(project, getattr(project, "focus", None))
         for raw in det.unordered_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+# ---------------------------------------------------------------------------
+# Typestate rules (drynx_tpu/analysis/typestate.py): four thin wrappers
+# over one shared resource-lifecycle run — typestate_for() memoizes on
+# the same content-hash fingerprint, so the interprocedural automaton
+# walk (instance tracking through parameters, returns, aliases, branch
+# joins and try/finally edges) is computed once per tree version for
+# all four protocols (and for the DRYNX_PROTO_TRACE runtime cross-check).
+
+@register
+class AtomicDurableWrite(ProjectRule):
+    """A durable artifact (ledger/journal/checkpoint/bench/slab/.npz
+    path) is written without the crash-consistent tmp-write -> fsync ->
+    rename protocol: an in-place ``open(final, "w")``, a rename before
+    the data hit the disk (no ``os.fsync`` between the last write and
+    the publish), a write after the file was already published, or a
+    tmp file that is flushed but never renamed into place. Any of these
+    can leave a torn or missing artifact after a crash — the pool
+    store's replay and the proof transcript both assume publishes are
+    all-or-nothing. Append-mode opens of durable paths are only legal
+    in modules that declare a replay routine (the journal idiom).
+    Fix with the ``_atomic_write_npz`` shape; a deliberately relaxed
+    write (scratch diagnostics) is declared with
+    ``# drynx: protocol[reason]`` at the open or the violation site."""
+
+    id = "atomic-durable-write"
+    engine = "typestate"
+    summary = ("durable-path write skips the tmp-write -> fsync -> "
+               "rename crash-consistency protocol (typestate)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .typestate import typestate_for
+        ts = typestate_for(project, getattr(project, "focus", None))
+        for raw in ts.atomic_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class SlabConsumptionOrder(ProjectRule):
+    """A claimed pool slab (the ``os.rename`` claim-move that fences
+    out concurrent consumers) is consumed out of order: read before its
+    consumption was journaled in the fsync'd ledger, unlinked before it
+    was read, or claimed and then leaked without the final unlink. The
+    ledger append IS the commit point — a crash between claim and
+    append must leave evidence for replay, so reading or deleting
+    first reintroduces the double-spend/lost-slab windows the pool
+    store's recovery protocol exists to close. The required order is
+    claim-rename -> ledger append -> read -> unlink, machine-checked
+    per instance across calls and exception edges."""
+
+    id = "slab-consumption-order"
+    engine = "typestate"
+    summary = ("claimed slab read/unlinked before the fsync'd ledger "
+               "append, or never unlinked (typestate)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .typestate import typestate_for
+        ts = typestate_for(project, getattr(project, "focus", None))
+        for raw in ts.slab_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class ConnCheckoutDiscipline(ProjectRule):
+    """A connection checked out of a ``ConnPool`` (or constructed
+    directly) fails to reach exactly one terminal — ``put``/``discard``
+    back to the pool or ``close`` — on some path, including exception
+    edges: a return/raise that abandons the socket, or a conn that a
+    transport failure (``CallTimeout``/``TransportError``/``OSError``
+    handler) marked suspect being reused or returned to the pool as if
+    healthy. Leaks starve the pool under load; returning a suspect
+    conn poisons a later checkout with a dead socket. The walker
+    tracks each instance through helper calls, aliases and
+    try/finally, so release-in-a-helper and retry-loop idioms are
+    recognized; the finding's codeFlow shows the path that leaks."""
+
+    id = "conn-checkout-discipline"
+    engine = "typestate"
+    summary = ("pool conn misses put/discard/close on some path, or is "
+               "reused after a transport failure (typestate)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .typestate import typestate_for
+        ts = typestate_for(project, getattr(project, "focus", None))
+        for raw in ts.conn_raw:
+            if project.in_focus(raw.file):
+                yield _raw_to_finding(self.id, project, raw)
+
+
+@register
+class SealCommitOnce(ProjectRule):
+    """A streaming pane is sealed twice under one pane key, a pane's
+    proof blob is committed twice, or a checkpoint loaded for resume is
+    saved again without re-entering a phase (a blind save would
+    overwrite the resume evidence — the ``phase_entries`` counters —
+    with stale state). Seal and commit are at-most-once per instance
+    per path: the VN verify cache and the epsilon ledger both key on
+    the pane identity, so a double seal double-charges and a double
+    commit forks the audit trail. The checkpoint clause enforces
+    load -> enter -> save ordering per ``SurveyCheckpoint`` instance."""
+
+    id = "seal-commit-once"
+    engine = "typestate"
+    summary = ("pane sealed/committed twice under one key, or a "
+               "resumed checkpoint saved without re-entering a phase "
+               "(typestate)")
+
+    def run_project(self, project: ProjectInfo) -> Iterator[Finding]:
+        from .typestate import typestate_for
+        ts = typestate_for(project, getattr(project, "focus", None))
+        for raw in ts.seal_raw:
             if project.in_focus(raw.file):
                 yield _raw_to_finding(self.id, project, raw)

@@ -58,6 +58,7 @@ import zipfile
 import numpy as np
 
 _DET_TRACE = os.environ.get("DRYNX_DET_TRACE", "0") == "1"
+_PROTO_TRACE = os.environ.get("DRYNX_PROTO_TRACE", "0") == "1"
 
 
 def mmap_enabled() -> bool:
@@ -164,11 +165,24 @@ def _atomic_write_npz(path: str, **arrays) -> None:
     FileNotFoundError under the DP dispatch fan-out). Distinct tmps make
     concurrent same-digest writes last-writer-wins over identical bytes."""
     tmp = f"{path}.{secrets.token_hex(8)}.tmp"
+    inst = None
+    if _PROTO_TRACE:
+        from ..analysis import prototrace
+        inst = prototrace.new_instance("atomic")
+        prototrace.record(inst, "open")
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+        if inst:
+            prototrace.record(inst, "write")
         f.flush()
         os.fsync(f.fileno())
+        if inst:
+            prototrace.record(inst, "fsync")
+    if inst:
+        prototrace.record(inst, "close")
     os.replace(tmp, path)
+    if inst:
+        prototrace.record(inst, "rename")
     _fsync_dir(os.path.dirname(path))
 
 
@@ -234,6 +248,11 @@ class CryptoPool:
                 f.write(line + "\n")
                 f.flush()
                 os.fsync(f.fileno())
+            if _PROTO_TRACE:
+                from ..analysis import prototrace
+                inst = prototrace.new_instance("journal")
+                prototrace.record(inst, "append")
+                prototrace.record(inst, "fsync")
 
     # -- crash recovery ----------------------------------------------------
 
@@ -306,9 +325,16 @@ class CryptoPool:
             # removes a live slab file
             raise DoubleConsumption(
                 f"slab {sid} claimed concurrently") from None
+        inst = None
+        if _PROTO_TRACE:
+            from ..analysis import prototrace
+            inst = prototrace.new_instance("slab")
+            prototrace.record(inst, "claim")
         self._ledger_append({"ev": "consume", "slab": sid,
                              "digest": digest,
                              "elems": _slab_elems(path)})
+        if inst:
+            prototrace.record(inst, "journal")
         with self._lock:
             self._consumed.add(sid)
             self.counters["consumed"] += 1
@@ -322,7 +348,11 @@ class CryptoPool:
         else:
             with np.load(claimed) as d:
                 out = (d["zero_ct"].copy(), d["r"].copy())
+        if inst:
+            prototrace.record(inst, "read")
         os.unlink(claimed)
+        if inst:
+            prototrace.record(inst, "unlink")
         return out
 
     def consume_slab(self, digest: str, slab_id: str):

@@ -22,6 +22,12 @@ from ..resilience.policy import named_lock
 # skipchain blocks and checkpoint persistence, all of which land here.
 _DET_TRACE = os.environ.get("DRYNX_DET_TRACE", "0") == "1"
 
+# DRYNX_PROTO_TRACE: report SurveyCheckpoint lifecycle events
+# (ctor/load/enter/save) to the runtime protocol recorder
+# (analysis/prototrace.py) — the dynamic half of the seal-commit-once
+# typestate rule's checkpoint clause.
+_PROTO_TRACE = os.environ.get("DRYNX_PROTO_TRACE", "0") == "1"
+
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                     "proofdb.cpp")
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native",
@@ -216,9 +222,25 @@ class SurveyCheckpoint:
     phase_entries: dict = dataclasses.field(default_factory=dict)
     progress: dict = dataclasses.field(default_factory=dict)
 
+    def _proto_event(self, event: str) -> None:
+        """Report a lifecycle event to the runtime protocol recorder.
+        The token is minted lazily at the first event so the
+        ``from_bytes`` constructor used by :meth:`load` doesn't record
+        a spurious ``ctor`` before the ``load`` event."""
+        from ..analysis import prototrace
+        inst = getattr(self, "_proto_inst", None)
+        if inst is None:
+            inst = prototrace.new_instance("ckpt")
+            self._proto_inst = inst
+            if event != "load":
+                prototrace.record(inst, "ctor")
+        prototrace.record(inst, event)
+
     def enter(self, phase: str) -> "SurveyCheckpoint":
         """Record entry into a phase (idempotent re-entries increment
         the counter — that asymmetry is the resume evidence)."""
+        if _PROTO_TRACE:
+            self._proto_event("enter")
         self.phase = phase
         self.phase_entries[phase] = self.phase_entries.get(phase, 0) + 1
         return self
@@ -232,6 +254,8 @@ class SurveyCheckpoint:
         return cls(**json.loads(raw.decode()))
 
     def save(self, db: "ProofDB | None") -> None:
+        if _PROTO_TRACE:
+            self._proto_event("save")
         if db is not None:
             db.put(_CKPT_PREFIX + self.survey_id.encode(),
                    self.to_bytes())
@@ -244,7 +268,10 @@ class SurveyCheckpoint:
         raw = db.get(_CKPT_PREFIX + survey_id.encode())
         if not raw:
             return None
-        return cls.from_bytes(raw)
+        ck = cls.from_bytes(raw)
+        if _PROTO_TRACE:
+            ck._proto_event("load")
+        return ck
 
 
 __all__ = ["ProofDB", "SurveyCheckpoint", "pane_key"]

@@ -87,6 +87,11 @@ from . import topology as topo
 from .service import Survey, _once, _pickle
 from .store import pane_key
 
+# DRYNX_PROTO_TRACE: report pane seal / proof-commit lifecycle events
+# to the runtime protocol recorder (analysis/prototrace.py) — the
+# dynamic half of the seal-commit-once typestate rule.
+_PROTO_TRACE = os.environ.get("DRYNX_PROTO_TRACE", "0") == "1"
+
 # Encodings whose window statistic is the exact sum of per-pane
 # encodings — the precondition for expired-pane subtraction. The grid
 # decode modes (quantile/median/top_k/union-style presence) all read a
@@ -304,6 +309,9 @@ class StreamEngine:
             pane.block = self._deliver_pane_proofs(pane)
         self._panes.append(pane)
         self.counters["panes_sealed"] += 1
+        if _PROTO_TRACE:
+            from ..analysis import prototrace
+            prototrace.record(prototrace.new_instance("seal"), "seal")
         tm.end("PaneSeal")
         return pane
 
@@ -337,9 +345,13 @@ class StreamEngine:
                     f"range-{d.name}-p{pane.pane_id}", 0,
                     pane.blobs[d.name], d.secret)
                 cluster.vns.deliver(req)
-        return cluster.vns.end_verification(
+        block = cluster.vns.end_verification(
             psid, timeout=rp.VN_GROUP_WAIT_S,
             quorum=self.sq_proto.vn_quorum)
+        if _PROTO_TRACE:
+            from ..analysis import prototrace
+            prototrace.record(prototrace.new_instance("seal"), "commit")
+        return block
 
     # -- epsilon accounting ------------------------------------------------
 

@@ -67,6 +67,19 @@ import numpy as np
 from ..resilience import faults
 from ..resilience import policy as rp
 
+# DRYNX_PROTO_TRACE: record every Conn lifecycle event (checkout, use,
+# break, put/discard/close) into the runtime protocol recorder
+# (analysis/prototrace.py) so the chaos cross-check can assert the
+# observed sequences against the conn-checkout-discipline automaton.
+_PROTO_TRACE = os.environ.get("DRYNX_PROTO_TRACE", "0") == "1"
+
+
+def _proto_record(conn: "Conn", event: str) -> None:
+    inst = getattr(conn, "_proto_inst", None)
+    if inst:
+        from ..analysis import prototrace
+        prototrace.record(inst, event)
+
 
 # ---------------------------------------------------------------------------
 # Typed failure hierarchy
@@ -817,7 +830,15 @@ class Conn:
                                                  timeout=timeout)
         except OSError as e:
             raise ConnectError(f"connect to {self.peer} failed: {e}") from e
-        want = wire_default()
+        self._negotiate(wire_default())
+        if _PROTO_TRACE:
+            # minted only for fully constructed conns: a failed
+            # negotiation raises before any caller holds a checkout
+            from ..analysis import prototrace
+            self._proto_inst = prototrace.new_instance("conn")
+            prototrace.record(self._proto_inst, "checkout")
+
+    def _negotiate(self, want: int) -> None:
         if want >= 2:
             try:
                 send_frame(self.sock, {"type": "wire_hello", "max": want},
@@ -848,6 +869,8 @@ class Conn:
         if self.broken or self.closed:
             raise ConnectionClosed(
                 f"connection to {self.peer} already broken")
+        if _PROTO_TRACE:
+            _proto_record(self, "use")
         with self._lock:
             self.sent = False
             try:
@@ -901,6 +924,8 @@ class Conn:
         return reply
 
     def _mark_broken(self) -> None:
+        if _PROTO_TRACE and not self.broken:
+            _proto_record(self, "timeout")
         self.broken = True
         try:
             self.sock.close()
@@ -908,6 +933,8 @@ class Conn:
             pass
 
     def close(self) -> None:
+        if _PROTO_TRACE and not self.closed:
+            _proto_record(self, "close")
         self.closed = True
         self.sock.close()
 
@@ -992,6 +1019,12 @@ class ConnPool:
                 with self._lock:
                     self.reuses += 1
                 conn._timeout = float(timeout)
+                if _PROTO_TRACE:
+                    # a reuse starts a fresh checkout lifecycle: the
+                    # previous token ended at its accepting "returned"
+                    from ..analysis import prototrace
+                    conn._proto_inst = prototrace.new_instance("conn")
+                    prototrace.record(conn._proto_inst, "checkout")
                 return conn
             self.discard(conn)
         conn = Conn(host, port, timeout=timeout, peer=peer)
@@ -1032,6 +1065,8 @@ class ConnPool:
         if conn.broken or conn.closed:
             self.discard(conn)
             return
+        if _PROTO_TRACE:
+            _proto_record(conn, "put")
         key = self._key(conn)
         evicted: list[Conn] = []
         pooled = False
@@ -1080,6 +1115,8 @@ class ConnPool:
                 suspect: bool = True) -> None:
         if conn is None:
             return
+        if _PROTO_TRACE and not conn.closed:
+            _proto_record(conn, "discard")
         with self._lock:
             self.discards += 1
             if suspect:

@@ -30,6 +30,12 @@ echo "== cross-check runs in the chaos tier) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not chaos' \
     tests/test_determinism_analysis.py
 
+echo "== proto tier (typestate unit tests + fixture goldens + real-tree"
+echo "== clean gate; the DRYNX_PROTO_TRACE runtime lifecycle conformance"
+echo "== cross-check runs in the chaos tier) =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly -m 'not chaos' \
+    tests/test_typestate_analysis.py
+
 echo "== precompile registry smoke (trace+lower the proofs-on program set) =="
 JAX_PLATFORMS=cpu python -m drynx_tpu.precompile --dry-run --quiet
 
@@ -47,11 +53,13 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
 
 echo "== chaos quick tier (seeded fault injection, -m 'chaos and not slow';"
 echo "== + the DRYNX_LOCK_TRACE dynamic/static lock-order cross-check"
-echo "== + the DRYNX_DET_TRACE same-seed byte-identity replay check) =="
+echo "== + the DRYNX_DET_TRACE same-seed byte-identity replay check"
+echo "== + the DRYNX_PROTO_TRACE lifecycle-automata conformance check) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:randomly \
     -m 'chaos and not slow' tests/test_resilience.py \
     tests/test_concurrency_analysis.py \
-    tests/test_determinism_analysis.py
+    tests/test_determinism_analysis.py \
+    tests/test_typestate_analysis.py
 
 echo "== scale smoke (tiny grid points, one supervised child per point) =="
 python scripts/bench_scale_axes.py --cpu --smoke > /dev/null

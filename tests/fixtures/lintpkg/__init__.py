@@ -12,7 +12,11 @@ blocking sleep under both locks. determinism.py adds the four
 determinism violations: a wall-clock read and an os.urandom draw
 reaching byte-identity sinks (nondet-flow-to-transcript x2), plus a
 set-iteration write loop and an unsorted-listing digest
-(unordered-iteration-at-sink x2). tests/test_static_analysis.py
-asserts the CLI reports exactly these fifteen, each with a rendered
-call/value chain.
+(unordered-iteration-at-sink x2). typestate.py adds the four
+resource-lifecycle violations: an in-place durable write
+(atomic-durable-write), a slab read before its ledger append
+(slab-consumption-order), a pool checkout that leaks on the success
+path (conn-checkout-discipline), and a pane key stored twice
+(seal-commit-once). tests/test_static_analysis.py asserts the CLI
+reports exactly these nineteen, each with a rendered call/value chain.
 """

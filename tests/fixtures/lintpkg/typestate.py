@@ -1,0 +1,109 @@
+"""Typestate fixture: resource-lifecycle protocol violations.
+
+Exactly four typestate violations, one per project rule from
+drynx_tpu/analysis/typestate.py:
+
+* ``journal_in_place`` opens a durable ``.jsonl`` path in ``"w"`` mode
+  and writes it in place — one ``atomic-durable-write`` (the
+  crash-consistent shape is tmp-write -> fsync -> rename).
+* ``consume_eager`` claims a slab with the fenced rename but reads it
+  *before* the fsync'd ledger append commits the consumption — one
+  ``slab-consumption-order``.
+* ``checkout_leaks`` checks a conn out of the pool and returns without
+  ``put``/``discard``/``close`` on the success path — one
+  ``conn-checkout-discipline`` with an interprocedural-free 2-hop flow.
+* ``seal_twice`` stores two blobs under one ``pane_key`` — one
+  ``seal-commit-once`` (the VN verify cache and epsilon ledger key on
+  the pane identity).
+
+Negative controls that must NOT be reported: ``publish_atomic`` does
+the full tmp-write -> fsync -> close -> replace dance;
+``append_journal`` appends to a durable path in a module that declares
+``replay_journal`` (the journal idiom); ``consume_ordered`` claims,
+journals, reads and unlinks in protocol order; ``checkout_returns``
+releases on both the success and failure edges; and ``seal_once``
+stores each pane key exactly once.
+"""
+import os
+
+
+def _ledger_append(path, entry):
+    return entry
+
+
+def replay_journal(path):
+    return []
+
+
+def pane_key(stream_id, pane_id, name):
+    return f"{stream_id}:{pane_id}:{name}".encode()
+
+
+def journal_in_place(root, entry):
+    fh = open(os.path.join(root, "epsilon.jsonl"), "w")
+    fh.write(entry)
+    fh.close()
+
+
+def consume_eager(np, slab, ledger):
+    claimed = slab + ".claim"
+    os.rename(slab, claimed)
+    arrs = np.load(claimed)
+    _ledger_append(ledger, slab)
+    os.unlink(claimed)
+    return arrs
+
+
+def checkout_leaks(pool, host):
+    conn = pool.get(host, 9000)
+    return conn.call(b"ping")
+
+
+def seal_twice(db, stream_id, blob):
+    key = pane_key(stream_id, 0, "dp0")
+    db.put(key, blob)
+    db.put(key, blob)
+
+
+def publish_atomic(root, payload):
+    final = os.path.join(root, "bench_record.jsonl")
+    tmp = final + ".tmp"
+    fh = open(tmp, "w")
+    fh.write(payload)
+    fh.flush()
+    os.fsync(fh.fileno())
+    fh.close()
+    os.replace(tmp, final)
+
+
+def append_journal(root, entry):
+    fh = open(os.path.join(root, "epsilon.jsonl"), "a")
+    fh.write(entry)
+    fh.flush()
+    os.fsync(fh.fileno())
+    fh.close()
+
+
+def consume_ordered(np, slab, ledger):
+    claimed = slab + ".claim"
+    os.rename(slab, claimed)
+    _ledger_append(ledger, slab)
+    arrs = np.load(claimed)
+    os.unlink(claimed)
+    return arrs
+
+
+def checkout_returns(pool, host, msg):
+    conn = pool.get(host, 9000)
+    try:
+        reply = conn.call(msg)
+    except OSError:
+        pool.discard(conn)
+        raise
+    pool.put(conn)
+    return reply
+
+
+def seal_once(db, stream_id, blobs):
+    for pid, blob in blobs:
+        db.put(pane_key(stream_id, pid, "dp0"), blob)

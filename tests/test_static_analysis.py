@@ -35,7 +35,9 @@ def test_registry_has_the_documented_rules():
                 "ciphertext-dtype-launder", "secret-flow-to-sink",
                 "unguarded-shared-mutation", "lock-order-inversion",
                 "blocking-call-under-lock", "nondet-flow-to-transcript",
-                "unordered-iteration-at-sink"}
+                "unordered-iteration-at-sink", "atomic-durable-write",
+                "slab-consumption-order", "conn-checkout-discipline",
+                "seal-commit-once"}
     assert expected <= set(RULES), sorted(expected - set(RULES))
 
 
@@ -96,12 +98,15 @@ def test_cli_passes_a_clean_file(tmp_path):
 # -- whole-program pass ------------------------------------------------------
 
 def test_project_pass_is_clean_and_fast():
-    # the acceptance budget: import graph + callgraph + all three project
-    # rules over the whole package, under five seconds, zero findings.
-    # Measured in a fresh interpreter — the way the pass actually runs
-    # (check.sh lint tiers, the CLI): inside a long pytest session the
-    # accumulated heap roughly doubles the in-process wall time, which
-    # says nothing about the pass itself.
+    # the acceptance budget: import graph + callgraph + every project
+    # rule (all five engine families) over the whole package, zero
+    # findings. Measured in a fresh interpreter — the way the pass
+    # actually runs (check.sh lint tiers, the CLI): inside a long
+    # pytest session the accumulated heap roughly doubles the
+    # in-process wall time, which says nothing about the pass itself.
+    # Budget 7s: idle measures ~4.7s after the typestate engine joined
+    # (the quadratic ModuleInfo scans were flattened to pay for it);
+    # the headroom absorbs a loaded CI box, not engine growth.
     prog = (
         "import json, sys, time\n"
         "from drynx_tpu.analysis.project import analyze_project\n"
@@ -116,8 +121,8 @@ def test_project_pass_is_clean_and_fast():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     out = json.loads(proc.stdout)
     assert out["findings"] == [], "\n".join(out["findings"])
-    assert out["elapsed"] < 5.0, \
-        f"project pass took {out['elapsed']:.1f}s (budget 5s)"
+    assert out["elapsed"] < 7.0, \
+        f"project pass took {out['elapsed']:.1f}s (budget 7s)"
 
 
 def test_list_rules_marks_project_rules():
@@ -128,13 +133,15 @@ def test_list_rules_marks_project_rules():
     assert "unsafe-pickle:" in proc.stdout  # per-module rules unmarked
 
 
-def test_fixture_package_yields_exactly_the_fifteen_findings():
+def test_fixture_package_yields_exactly_the_nineteen_findings():
     proc = _cli([str(FIXTURE), "--no-baseline"])
     assert proc.returncode == 1, proc.stdout + proc.stderr
     out = proc.stdout
     for rule in ("cross-module-flag-capture", "host-sync-in-hot-path",
                  "pallas-operand-dtype", "ciphertext-dtype-launder",
-                 "lock-order-inversion", "blocking-call-under-lock"):
+                 "lock-order-inversion", "blocking-call-under-lock",
+                 "atomic-durable-write", "slab-consumption-order",
+                 "conn-checkout-discipline", "seal-commit-once"):
         assert out.count(f"[{rule}]") == 1, out
     # announce + annotated_leak (annotation seed) + batch_leak (container
     # mutation) — see the fixture docstring
@@ -145,7 +152,7 @@ def test_fixture_package_yields_exactly_the_fifteen_findings():
     # unsorted-listing — two per determinism rule
     assert out.count("[nondet-flow-to-transcript]") == 2, out
     assert out.count("[unordered-iteration-at-sink]") == 2, out
-    assert out.count("call chain:") == 15, out
+    assert out.count("call chain:") == 19, out
 
 
 def test_json_format_has_stable_call_chain_field():
@@ -153,7 +160,7 @@ def test_json_format_has_stable_call_chain_field():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     findings = data["findings"]
-    assert len(findings) == 15
+    assert len(findings) == 19
     for f in findings:
         assert isinstance(f["call_chain"], list) and f["call_chain"]
         assert all(isinstance(h, str) for h in f["call_chain"])
